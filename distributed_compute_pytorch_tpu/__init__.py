@@ -1,0 +1,31 @@
+"""distributed_compute_pytorch_tpu — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA framework with the capabilities of the reference repo
+``saandeepa93/distributed_compute_pytorch`` (a single-file PyTorch DDP MNIST
+trainer, see ``/root/reference/main.py``), redesigned TPU-first:
+
+- One SPMD program over a ``jax.sharding.Mesh`` instead of one process per
+  device (reference ``main.py:150`` ``mp.spawn``).
+- Gradient synchronisation is a compiled XLA ``psum`` induced by sharding
+  annotations instead of DDP's bucketed NCCL/gloo all-reduce
+  (reference ``main.py:122``).
+- Data sharding is a deterministic, epoch-keyed global permutation
+  (reference ``DistributedSampler``, ``main.py:109``).
+- Collective metric aggregation happens device-side inside the jitted step
+  (reference ``dist.all_reduce``, ``main.py:65,90,91``).
+
+Subpackages
+-----------
+core      mesh/topology, distributed init, configuration
+data      dataset readers, sharded sampling, device feeding
+models    layer library and model zoo (ConvNet, ResNet, BERT, GPT-2)
+ops       numerical ops and Pallas TPU kernels
+parallel  partition strategies (DP, FSDP, TP, sequence/ring attention)
+train     trainer loop, optimizer/schedule, metrics, checkpointing
+utils     logging, timing
+"""
+
+__version__ = "0.1.0"
+
+from distributed_compute_pytorch_tpu.core.config import Config  # noqa: F401
+from distributed_compute_pytorch_tpu.core.mesh import MeshSpec, make_mesh  # noqa: F401

@@ -1,0 +1,19 @@
+"""Core runtime: configuration, device mesh/topology, distributed init."""
+
+from distributed_compute_pytorch_tpu.core.config import Config
+from distributed_compute_pytorch_tpu.core.mesh import (
+    MeshSpec,
+    make_mesh,
+    initialize_distributed,
+    process_count,
+    process_index,
+)
+
+__all__ = [
+    "Config",
+    "MeshSpec",
+    "make_mesh",
+    "initialize_distributed",
+    "process_count",
+    "process_index",
+]

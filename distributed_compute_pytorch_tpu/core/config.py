@@ -1,0 +1,137 @@
+"""Run configuration.
+
+The reference exposes exactly six CLI knobs via argparse
+(``/root/reference/main.py:139-144``): ``--batch_size`` (128), ``--lr``
+(0.001), ``--epochs`` (20), ``--no-cuda``, ``--gamma`` (0.7), ``--gpus`` (4).
+Here the same knobs live in one dataclass; the device-count knob becomes a
+mesh spec, and ``--no-cuda`` becomes a real boolean ``--force-cpu``
+(the reference's flag is broken — it takes a value and truthy strings like
+``'False'`` disable CUDA; see SURVEY.md §A.7. We fix it.)
+
+Rendezvous configuration (reference hard-codes ``MASTER_ADDR=localhost``,
+``MASTER_PORT=12355`` at ``main.py:48-49``) comes from flags/env instead, so
+multi-host actually works.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def _env(name: str, default: str | None = None) -> str | None:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+@dataclass
+class Config:
+    """All knobs for a training run.
+
+    The first block mirrors the reference CLI one-to-one
+    (``main.py:139-144``); the rest are framework additions the reference
+    either hard-codes or lacks.
+    """
+
+    # --- reference-parity knobs (main.py:139-144) ---
+    batch_size: int = 128          # global batch size, like the reference's per-run bs
+    lr: float = 1e-3               # Adadelta lr (reference default 0.001, main.py:140)
+    epochs: int = 20               # main.py:141
+    force_cpu: bool = False        # fixed --no-cuda (main.py:142, SURVEY §A.7)
+    gamma: float = 0.7             # StepLR decay per epoch (main.py:143)
+    mesh: str = "data=-1"          # replaces --gpus: mesh axes spec, e.g. "data=4",
+                                   # "data=2,fsdp=4", "data=1,tensor=4,seq=2"; -1 = infer
+
+    # --- model / task selection (the reference has one model; we have a zoo) ---
+    model: str = "convnet"         # convnet | resnet18 | resnet50 | bert | gpt2
+    dataset: str = "mnist"         # mnist | cifar10 | synthetic-images | synthetic-lm
+
+    # --- logging / metrics (cadence matches main.py:64) ---
+    log_every: int = 10            # print a loss line every N steps (main.py:64)
+    seed: int = 0                  # torch.manual_seed(0) equivalent (main.py:103)
+
+    # --- data / checkpoint paths ---
+    data_dir: str = "./data"       # reference uses './data/' (main.py:107)
+    ckpt_path: str = "checkpoint.msgpack"  # reference writes 'mnist.pt' (main.py:133)
+    resume: bool = False           # restore path the reference lacks (SURVEY §5.4)
+
+    # --- distributed rendezvous (replaces main.py:48-49 hard-coding) ---
+    coordinator: str | None = field(
+        default_factory=lambda: _env("DCP_COORDINATOR"))
+    num_processes: int | None = field(
+        default_factory=lambda: (lambda v: int(v) if v else None)(_env("DCP_NUM_PROCESSES")))
+    process_id: int | None = field(
+        default_factory=lambda: (lambda v: int(v) if v else None)(_env("DCP_PROCESS_ID")))
+
+    # --- numerics / performance ---
+    compute_dtype: str = "float32"   # bfloat16 for TPU speed; float32 for parity tests
+    param_dtype: str = "float32"
+    donate: bool = True              # donate train-state buffers to the jitted step
+    profile_dir: str | None = None   # opt-in XLA profiler traces (SURVEY §5.1)
+
+    # --- eval behaviour: reference evaluates on the TRAIN set (main.py:130, bug §A.1).
+    # We default to the test split but keep the knob for log-comparison runs.
+    eval_on_train: bool = False
+
+    def mesh_axes(self) -> dict[str, int]:
+        """Parse the mesh spec string into an ordered ``{axis: size}`` dict."""
+        axes: dict[str, int] = {}
+        for part in self.mesh.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, size = part.partition("=")
+            axes[name.strip()] = int(size) if size else -1
+        return axes or {"data": -1}
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    # ---- CLI shim: same role as reference argparse block (main.py:137-145) ----
+    @classmethod
+    def parser(cls) -> argparse.ArgumentParser:
+        p = argparse.ArgumentParser(
+            description="TPU-native distributed trainer "
+                        "(capability parity with reference main.py)")
+        p.add_argument("--batch_size", type=int, default=cls.batch_size,
+                       help="global batch size of train and test")
+        p.add_argument("--lr", type=float, default=cls.lr, help="LR of optimizer")
+        p.add_argument("--epochs", type=int, default=cls.epochs, help="# of epochs")
+        p.add_argument("--force-cpu", action="store_true", dest="force_cpu",
+                       help="run on host CPU devices (fixed --no-cuda)")
+        p.add_argument("--gamma", type=float, default=cls.gamma,
+                       help="gamma value for lr update")
+        p.add_argument("--mesh", type=str, default=cls.mesh,
+                       help="device mesh spec, e.g. 'data=8' or 'data=2,fsdp=4'")
+        p.add_argument("--model", type=str, default=cls.model)
+        p.add_argument("--dataset", type=str, default=cls.dataset)
+        p.add_argument("--log_every", type=int, default=cls.log_every)
+        p.add_argument("--seed", type=int, default=cls.seed)
+        p.add_argument("--data_dir", type=str, default=cls.data_dir)
+        p.add_argument("--ckpt_path", type=str, default=cls.ckpt_path)
+        p.add_argument("--resume", action="store_true")
+        p.add_argument("--coordinator", type=str, default=None,
+                       help="host:port of process 0 (multi-host rendezvous)")
+        p.add_argument("--num_processes", type=int, default=None)
+        p.add_argument("--process_id", type=int, default=None)
+        p.add_argument("--compute_dtype", type=str, default=cls.compute_dtype)
+        p.add_argument("--param_dtype", type=str, default=cls.param_dtype)
+        p.add_argument("--profile_dir", type=str, default=None)
+        p.add_argument("--eval_on_train", action="store_true",
+                       help="replicate reference bug §A.1 (eval on train split)")
+        return p
+
+    @classmethod
+    def from_argv(cls, argv: list[str] | None = None) -> "Config":
+        ns = cls.parser().parse_args(argv)
+        base = cls()
+        kw = {f.name: getattr(ns, f.name) for f in dataclasses.fields(cls)
+              if hasattr(ns, f.name)}
+        # env-derived fields fall back to env when flags were not given
+        for k in ("coordinator", "num_processes", "process_id"):
+            if kw.get(k) is None:
+                kw[k] = getattr(base, k)
+        return cls(**kw)

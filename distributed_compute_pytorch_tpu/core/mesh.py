@@ -1,0 +1,192 @@
+"""Device mesh / topology and multi-host rendezvous.
+
+Replaces the reference's process-group lifecycle (``/root/reference/main.py:47-53``:
+env-var TCP rendezvous on hard-coded ``localhost:12355`` + gloo) and its
+one-process-per-device spawn (``main.py:150``) with the TPU-idiomatic design:
+
+- ONE process per host, ``jax.distributed.initialize`` for multi-host
+  rendezvous (the coordinator plays the MASTER_ADDR role).
+- A named ``jax.sharding.Mesh`` over all devices; parallelism is expressed as
+  sharding over named axes and compiled collectives ride ICI within a slice
+  and DCN across slices — no gloo/NCCL equivalent to hand-write.
+
+Canonical axis names used throughout the framework:
+
+====== =============================================================
+axis   meaning
+====== =============================================================
+data   data parallel (batch sharding; grads psum over this axis)
+fsdp   parameter/optimizer sharding (ZeRO-3 style), also shards batch
+tensor tensor (Megatron-style) model parallelism
+seq    sequence/context parallelism (ring attention)
+pipe   pipeline stages
+expert expert parallelism (MoE)
+====== =============================================================
+
+For tests without TPU hardware, fake an N-device CPU mesh with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N JAX_PLATFORMS=cpu``
+(must be set before JAX backends initialise — see ``tests/conftest.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Axes over which the global batch is sharded. Everything else (tensor, seq,
+# pipe) sees the same examples.
+BATCH_AXES = ("data", "fsdp")
+ALL_AXES = ("data", "fsdp", "tensor", "seq", "pipe", "expert")
+
+_initialized = False
+
+
+def initialize_distributed(coordinator: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host rendezvous — the ``setup()`` equivalent (``main.py:47-50``).
+
+    A no-op for single-process runs (the common dev/test path). On a TPU pod,
+    call once per host before touching devices; all hosts block until the
+    full world joins, exactly like ``dist.init_process_group`` blocking on
+    rendezvous (``main.py:50``), except there is one process per *host*, not
+    per device.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator is None and num_processes is None:
+        # Single-controller / auto-detected environments (Cloud TPU metadata,
+        # or plain single-process): nothing to do.
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """This host's index — the closest analogue of the reference's ``rank``."""
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    """True on the logical rank-0 host (reference's ``rank == 0`` guards,
+    ``main.py:66,93``)."""
+    return jax.process_index() == 0
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """An ordered mapping of axis name -> size; at most one size may be -1
+    (inferred from the device count), mirroring the ergonomics of the
+    reference's single ``--gpus`` knob (``main.py:144``)."""
+
+    axes: tuple[tuple[str, int], ...]
+
+    @classmethod
+    def parse(cls, spec: str | dict[str, int]) -> "MeshSpec":
+        if isinstance(spec, str):
+            d: dict[str, int] = {}
+            for part in spec.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                name, _, size = part.partition("=")
+                d[name.strip()] = int(size) if size else -1
+            spec = d or {"data": -1}
+        for name in spec:
+            if name not in ALL_AXES:
+                raise ValueError(
+                    f"unknown mesh axis {name!r}; known axes: {ALL_AXES}")
+        return cls(axes=tuple(spec.items()))
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill in a single -1 so the axis sizes multiply to ``n_devices``."""
+        sizes = dict(self.axes)
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {unknown}")
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[unknown[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {known} devices, have {n_devices}")
+        return MeshSpec(axes=tuple(sizes.items()))
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(k for k, _ in self.axes)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(v for _, v in self.axes)
+
+    def size(self, name: str) -> int:
+        return dict(self.axes).get(name, 1)
+
+
+def make_mesh(spec: str | dict[str, int] | MeshSpec = "data=-1",
+              devices: list | None = None) -> Mesh:
+    """Build the named device mesh the whole framework computes over.
+
+    This is the structural replacement for the reference's world: where
+    ``main.py`` had ``world_size`` processes each owning one device
+    (``main.py:148,150``), we have one ``Mesh`` whose axes carry the
+    parallelism. Data-parallel world size == ``mesh.shape['data'] *
+    mesh.shape.get('fsdp', 1)``.
+    """
+    if not isinstance(spec, MeshSpec):
+        spec = MeshSpec.parse(spec)
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(spec.shape)
+    return Mesh(dev_array, axis_names=spec.names)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Sharding for a global batch: leading dim split over the batch axes
+    present in ``mesh``, remaining dims replicated. The SPMD analogue of the
+    reference's ``DistributedSampler`` handing each rank its slice
+    (``main.py:109``) — except the split happens in the array's sharding, not
+    in N separate processes."""
+    axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names and
+                 mesh.shape[a] > 1) or tuple(
+        a for a in BATCH_AXES if a in mesh.axis_names)
+    spec = P(axes if axes else None, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def dp_world_size(mesh: Mesh) -> int:
+    """Number of data-parallel shards (the reference's ``world_size``,
+    ``main.py:148``)."""
+    return math.prod(mesh.shape[a] for a in BATCH_AXES if a in mesh.axis_names)
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    ws = dp_world_size(mesh)
+    if global_batch % ws:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data-parallel world size {ws}")
+    return global_batch // ws

@@ -1,0 +1,7 @@
+"""Data layer: dataset readers, deterministic sharded sampling, device feed."""
+
+from distributed_compute_pytorch_tpu.data.sampler import ShardedSampler
+from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+from distributed_compute_pytorch_tpu.data.datasets import load_dataset, ArrayDataset
+
+__all__ = ["ShardedSampler", "DeviceFeeder", "load_dataset", "ArrayDataset"]

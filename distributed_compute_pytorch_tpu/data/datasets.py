@@ -1,0 +1,197 @@
+"""Dataset readers — no torchvision anywhere in the import graph.
+
+The reference pulls MNIST through ``torchvision.datasets.MNIST`` with
+``ToTensor`` + ``Normalize(0.1307, 0.3081)`` transforms
+(``/root/reference/main.py:107-108``). Here the idx-ubyte files are decoded
+directly (plain numpy; a C++ fast path lives in ``native/``), normalisation is
+identical, and when no data is on disk a *deterministic synthetic* dataset
+with the same shapes/statistics is generated so that tests and benchmarks
+never need network access (the reference instead download-races across ranks,
+SURVEY.md §A.8).
+
+Layout note: images are NHWC (TPU-native), not the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+MNIST_MEAN, MNIST_STD = 0.1307, 0.3081          # main.py:108
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+@dataclass(frozen=True)
+class ArrayDataset:
+    """An in-memory dataset of (inputs, targets) host arrays.
+
+    Everything upstream of the device feed is plain numpy: the sampler indexes
+    into these arrays to assemble global batches.
+    """
+
+    inputs: np.ndarray
+    targets: np.ndarray
+    name: str = "dataset"
+
+    def __post_init__(self):
+        assert len(self.inputs) == len(self.targets)
+
+    def __len__(self) -> int:
+        return len(self.inputs)
+
+    @property
+    def num_classes(self) -> int:
+        return int(self.targets.max()) + 1
+
+
+# --------------------------------------------------------------------------
+# idx-ubyte decoding (the format torchvision decodes for the reference)
+# --------------------------------------------------------------------------
+
+def _read_idx(path: str) -> np.ndarray:
+    """Decode one idx-ubyte file (optionally gzipped)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    zeros, dtype_code, ndim = struct.unpack(">HBB", data[:4])
+    if zeros != 0:
+        raise ValueError(f"{path}: bad idx magic")
+    dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+              0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+    shape = struct.unpack(f">{ndim}I", data[4:4 + 4 * ndim])
+    return np.frombuffer(data, dtypes[dtype_code], offset=4 + 4 * ndim).reshape(shape)
+
+
+def _find_idx(data_dir: str, stem: str) -> str | None:
+    """Locate an idx file under data_dir, tolerating the common layouts
+    (flat, MNIST/raw/, gzipped)."""
+    candidates = [
+        stem, stem + ".gz",
+        os.path.join("MNIST", "raw", stem),
+        os.path.join("MNIST", "raw", stem + ".gz"),
+        os.path.join("raw", stem), os.path.join("raw", stem + ".gz"),
+    ]
+    for c in candidates:
+        p = os.path.join(data_dir, c)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def load_mnist(data_dir: str = "./data", split: str = "train",
+               synthetic_fallback: bool = True) -> ArrayDataset:
+    """MNIST with the reference's exact normalisation (``main.py:108``).
+
+    Returns images ``[N, 28, 28, 1] float32`` normalised by
+    ``(x/255 - 0.1307) / 0.3081`` and labels ``[N] int32``. Falls back to
+    :func:`synthetic_images` (same shapes) when files are absent.
+    """
+    prefix = "train" if split == "train" else "t10k"
+    img_path = _find_idx(data_dir, f"{prefix}-images-idx3-ubyte")
+    lbl_path = _find_idx(data_dir, f"{prefix}-labels-idx1-ubyte")
+    if img_path and lbl_path:
+        raw = _read_idx(img_path).astype(np.float32) / 255.0
+        images = ((raw - MNIST_MEAN) / MNIST_STD)[..., None]
+        labels = _read_idx(lbl_path).astype(np.int32)
+        return ArrayDataset(images, labels, name=f"mnist-{split}")
+    if not synthetic_fallback:
+        raise FileNotFoundError(f"MNIST idx files not found under {data_dir}")
+    n = 60_000 if split == "train" else 10_000
+    return synthetic_images(n, (28, 28, 1), 10, seed=0 if split == "train" else 1,
+                            name=f"mnist-{split}-synthetic")
+
+
+def load_cifar10(data_dir: str = "./data", split: str = "train",
+                 synthetic_fallback: bool = True) -> ArrayDataset:
+    """CIFAR-10 from the python-pickle batches; synthetic fallback otherwise."""
+    import pickle
+    base = None
+    for cand in ("cifar-10-batches-py", "."):
+        p = os.path.join(data_dir, cand)
+        if os.path.exists(os.path.join(p, "data_batch_1")):
+            base = p
+            break
+    if base is not None:
+        files = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if split == "train" else ["test_batch"])
+        xs, ys = [], []
+        for fn in files:
+            with open(os.path.join(base, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        x = (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
+        return ArrayDataset(x, np.asarray(ys, np.int32), name=f"cifar10-{split}")
+    if not synthetic_fallback:
+        raise FileNotFoundError(f"CIFAR-10 not found under {data_dir}")
+    n = 50_000 if split == "train" else 10_000
+    return synthetic_images(n, (32, 32, 3), 10, seed=2 if split == "train" else 3,
+                            name=f"cifar10-{split}-synthetic")
+
+
+# --------------------------------------------------------------------------
+# deterministic synthetic datasets (tests / benchmarks / no-network runs)
+# --------------------------------------------------------------------------
+
+def synthetic_images(n: int, shape: tuple[int, ...], num_classes: int,
+                     seed: int = 0, name: str = "synthetic") -> ArrayDataset:
+    """Class-conditional gaussian blobs: learnable (a linear probe separates
+    them), deterministic, with roughly unit-normal pixel statistics so the
+    same model/normalisation pipeline applies.
+
+    The class prototypes depend only on (shape, num_classes) — the *task* —
+    so datasets drawn with different seeds/sizes are train/test splits of the
+    same problem; ``seed`` only varies which examples are drawn.
+    """
+    proto_rng = np.random.Generator(
+        np.random.Philox(key=hash((num_classes, *shape)) & 0xFFFFFFFF))
+    protos = proto_rng.normal(0.0, 1.0, size=(num_classes, *shape)).astype(np.float32)
+    rng = np.random.Generator(np.random.Philox(key=seed))
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    noise = rng.normal(0.0, 1.0, size=(n, *shape)).astype(np.float32)
+    images = 0.6 * protos[labels] + 0.8 * noise
+    return ArrayDataset(images.astype(np.float32), labels, name=name)
+
+
+def synthetic_lm(n: int, seq_len: int, vocab: int, seed: int = 0,
+                 name: str = "synthetic-lm") -> ArrayDataset:
+    """Token sequences from a deterministic order-1 Markov chain — enough
+    structure that a language model's loss visibly drops below the uniform
+    entropy floor. inputs = tokens[:, :-1] targets = tokens[:, 1:] framing is
+    left to the task; here both fields hold the full sequence."""
+    rng = np.random.Generator(np.random.Philox(key=seed))
+    # sparse-ish transition matrix
+    trans = rng.dirichlet(np.full(vocab, 0.05), size=vocab).astype(np.float64)
+    trans /= trans.sum(-1, keepdims=True)
+    toks = np.empty((n, seq_len), np.int32)
+    state = rng.integers(0, vocab, size=n)
+    cum = np.cumsum(trans, axis=-1)
+    for t in range(seq_len):
+        toks[:, t] = state
+        u = rng.random(n)
+        state = (cum[state] < u[:, None]).sum(-1)
+    return ArrayDataset(toks, toks, name=name)
+
+
+def load_dataset(name: str, data_dir: str = "./data", split: str = "train",
+                 **kw) -> ArrayDataset:
+    """Registry entry point used by the trainer CLI."""
+    if name == "mnist":
+        return load_mnist(data_dir, split)
+    if name == "cifar10":
+        return load_cifar10(data_dir, split)
+    if name == "synthetic-images":
+        return synthetic_images(kw.pop("n", 4096), kw.pop("shape", (28, 28, 1)),
+                                kw.pop("num_classes", 10),
+                                seed=0 if split == "train" else 1)
+    if name == "synthetic-lm":
+        return synthetic_lm(kw.pop("n", 2048), kw.pop("seq_len", 128),
+                            kw.pop("vocab", 256),
+                            seed=0 if split == "train" else 1)
+    raise ValueError(f"unknown dataset {name!r}")

@@ -1,0 +1,77 @@
+"""Deterministic epoch-keyed sharded sampling.
+
+Capability parity with ``torch.utils.data.distributed.DistributedSampler`` as
+used by the reference (``/root/reference/main.py:109,115``), whose semantics
+are:
+
+- a seeded global permutation of all example indices,
+- padding up to a multiple of world size by wrapping indices from the start
+  (``drop_last=False``), so every shard has equal length,
+- each rank takes a strided slice of the padded order.
+
+Two reference quirks handled deliberately (SURVEY.md §A.9):
+
+- The reference never calls ``sampler.set_epoch()``, so its shuffle order is
+  identical every epoch. We key the permutation by ``(seed, epoch)`` — the
+  fix — but passing ``epoch=0`` always reproduces reference behaviour.
+- In the SPMD design there is no per-rank sampler object: we produce the
+  *global* batch order once, and per-device slicing falls out of the batch
+  array's sharding over the mesh's batch axes. Per-process (multi-host)
+  slices are carved in :mod:`..data.loader`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ShardedSampler:
+    """Global batch order for one dataset.
+
+    Yields, per epoch, an ``[num_batches, global_batch]`` int array of example
+    indices: shuffled (epoch-keyed), padded by wraparound so that the last
+    batch is full (``DistributedSampler`` padding semantics + full final
+    batch, which static XLA shapes require).
+    """
+
+    num_examples: int
+    global_batch: int
+    shuffle: bool = True
+    seed: int = 0
+    drop_last: bool = False
+
+    @property
+    def num_batches(self) -> int:
+        if self.drop_last:
+            return self.num_examples // self.global_batch
+        return -(-self.num_examples // self.global_batch)  # ceil
+
+    @property
+    def padded_size(self) -> int:
+        return self.num_batches * self.global_batch
+
+    def epoch_order(self, epoch: int) -> np.ndarray:
+        """Padded global order for ``epoch`` as ``[num_batches, global_batch]``.
+
+        Deterministic: same ``(seed, epoch)`` -> same order on every process,
+        which is what makes the multi-host feed consistent without any
+        communication (the reference gets the same property from every rank
+        constructing the same seeded sampler, ``main.py:103,109``).
+        """
+        if self.shuffle:
+            rng = np.random.Generator(np.random.Philox(key=self.seed + epoch))
+            order = rng.permutation(self.num_examples)
+        else:
+            order = np.arange(self.num_examples)
+        if self.drop_last:
+            order = order[: self.padded_size]
+        else:
+            pad = self.padded_size - self.num_examples
+            if pad:
+                # wraparound padding — same rule as DistributedSampler's
+                # `indices += indices[:padding_size]`
+                order = np.concatenate([order, order[:pad]])
+        return order.reshape(self.num_batches, self.global_batch)

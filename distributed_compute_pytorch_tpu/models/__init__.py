@@ -1,0 +1,6 @@
+"""Model zoo built on the framework's own functional layer library."""
+
+from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+from distributed_compute_pytorch_tpu.models.registry import build_model
+
+__all__ = ["ConvNet", "build_model"]

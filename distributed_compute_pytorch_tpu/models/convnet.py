@@ -1,0 +1,82 @@
+"""The reference MNIST ConvNet, TPU-native.
+
+Exact architecture of ``/root/reference/main.py:20-45``:
+conv(1->32, 3x3, stride 1, valid) -> relu -> conv(32->64, 3x3) -> relu ->
+maxpool(2) -> dropout(0.25) -> flatten -> fc(9216->128) -> BatchNorm1d(128)
+-> relu -> dropout(0.5) -> fc(128->10) -> log_softmax.
+
+Differences by design: NHWC layout (28x28x1 in, so flatten still yields
+12*12*64 = 9216 features) and a pure functional forward — dropout keys and
+BatchNorm state are explicit, so the whole step jits as one XLA program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from distributed_compute_pytorch_tpu.models import layers as L
+
+
+@dataclass(frozen=True)
+class ConvNet:
+    num_classes: int = 10
+    in_channels: int = 1
+    param_dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        object.__setattr__(self, "conv1",
+                           L.Conv2d(self.in_channels, 32, 3, 1,
+                                    param_dtype=self.param_dtype))
+        object.__setattr__(self, "conv2",
+                           L.Conv2d(32, 64, 3, 1, param_dtype=self.param_dtype))
+        object.__setattr__(self, "fc1",
+                           L.Dense(9216, 128, param_dtype=self.param_dtype))
+        object.__setattr__(self, "fc2",
+                           L.Dense(128, self.num_classes,
+                                   param_dtype=self.param_dtype))
+        object.__setattr__(self, "bn", L.BatchNorm(128))
+
+    def init(self, key):
+        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+        params = {
+            "conv1": self.conv1.init(k1),
+            "conv2": self.conv2.init(k2),
+            "fc1": self.fc1.init(k3),
+            "batchnorm": self.bn.init(k4),
+            "fc2": self.fc2.init(k5),
+        }
+        state = {"batchnorm": self.bn.init_state()}
+        return params, state
+
+    def apply(self, params, state, x, *, train: bool = False, rng=None):
+        """Forward pass; returns (log_probs, new_state).
+
+        Mirrors reference ``forward`` (``main.py:31-45``) op-for-op.
+        """
+        if train and rng is None:
+            raise ValueError("train=True requires an rng for dropout")
+        r1 = r2 = None
+        if train:
+            r1, r2 = jax.random.split(rng)
+        x = self.conv1.apply(params["conv1"], x)
+        x = jax.nn.relu(x)
+        x = self.conv2.apply(params["conv2"], x)
+        x = jax.nn.relu(x)
+        x = L.max_pool2d(x, 2)
+        x = L.dropout(x, 0.25, r1, train)
+        x = x.reshape(x.shape[0], -1)
+        x = self.fc1.apply(params["fc1"], x)
+        x, bn_state = self.bn.apply(params["batchnorm"], state["batchnorm"],
+                                    x, train)
+        x = jax.nn.relu(x)
+        x = L.dropout(x, 0.5, r2, train)
+        x = self.fc2.apply(params["fc2"], x)
+        log_probs = L.log_softmax(x, -1)
+        return log_probs, {"batchnorm": bn_state}
+
+    def loss_fn(self, log_probs, targets):
+        """NLL loss, as the reference uses (``main.py:61``)."""
+        return L.nll_loss(log_probs, targets, reduction="mean")

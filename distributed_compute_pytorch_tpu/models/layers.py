@@ -1,0 +1,254 @@
+"""Functional layer library — the framework's own, no flax/haiku dependency.
+
+Design: a layer is a small dataclass with
+
+- ``init(key) -> params`` (a pytree of ``jax.Array``), and
+- ``apply(params, x, ...) -> y`` — a *pure function* of its inputs.
+
+Stateful layers (BatchNorm) additionally take/return a ``state`` pytree;
+stochastic layers (Dropout) take an explicit ``rng``. Models compose layers
+explicitly, so the whole forward pass is one traceable pure function —
+exactly what ``jax.jit``/``pjit`` want, and the reason gradient sync can be a
+compiled ``psum`` instead of the reference's DDP wrapper
+(``/root/reference/main.py:122``).
+
+Initialisation follows the PyTorch defaults the reference inherits from
+``nn.Conv2d``/``nn.Linear`` (kaiming-uniform with a=sqrt(5): weights and
+biases ~ U(-1/sqrt(fan_in), 1/sqrt(fan_in))), so seeded training curves are
+comparable with the reference's.
+
+Layouts are TPU-native: images NHWC, conv kernels HWIO (the reference's torch
+uses NCHW/OIHW; XLA:TPU strongly prefers channels-last).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _uniform(key, shape, bound, dtype):
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Dense:
+    """Affine layer ≈ ``nn.Linear`` (reference ``main.py:27-28``)."""
+
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        kw, kb = jax.random.split(key)
+        bound = 1.0 / math.sqrt(self.in_features)
+        p = {"kernel": _uniform(kw, (self.in_features, self.out_features),
+                                bound, self.param_dtype)}
+        if self.use_bias:
+            p["bias"] = _uniform(kb, (self.out_features,), bound, self.param_dtype)
+        return p
+
+    def apply(self, params, x):
+        y = x @ params["kernel"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+@dataclass(frozen=True)
+class Conv2d:
+    """2-D convolution ≈ ``nn.Conv2d`` (reference ``main.py:23-24``), NHWC/HWIO.
+
+    ``padding='VALID'`` matches torch's default ``padding=0`` the reference
+    uses for both convs.
+    """
+
+    in_channels: int
+    out_channels: int
+    kernel_size: int | tuple[int, int]
+    stride: int | tuple[int, int] = 1
+    padding: str | Sequence[tuple[int, int]] = "VALID"
+    use_bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    def _ks(self) -> tuple[int, int]:
+        k = self.kernel_size
+        return (k, k) if isinstance(k, int) else tuple(k)
+
+    def init(self, key):
+        kh, kwd = self._ks()
+        kw, kb = jax.random.split(key)
+        fan_in = self.in_channels * kh * kwd
+        bound = 1.0 / math.sqrt(fan_in)
+        p = {"kernel": _uniform(kw, (kh, kwd, self.in_channels, self.out_channels),
+                                bound, self.param_dtype)}
+        if self.use_bias:
+            p["bias"] = _uniform(kb, (self.out_channels,), bound, self.param_dtype)
+        return p
+
+    def apply(self, params, x):
+        s = self.stride
+        strides = (s, s) if isinstance(s, int) else tuple(s)
+        y = lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype),
+            window_strides=strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+def max_pool2d(x, window: int = 2, stride: int | None = None):
+    """``F.max_pool2d`` equivalent (reference ``main.py:36``), NHWC."""
+    stride = stride or window
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1), padding="VALID")
+
+
+def avg_pool2d(x, window: int = 2, stride: int | None = None):
+    stride = stride or window
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1), padding="VALID")
+    return summed / (window * window)
+
+
+def dropout(x, rate: float, rng, train: bool):
+    """``nn.Dropout`` equivalent (reference ``main.py:25-26``). Pure: identity
+    when not training or rate==0; otherwise inverted-scaling mask from ``rng``."""
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@dataclass(frozen=True)
+class BatchNorm:
+    """Batch normalisation ≈ ``nn.BatchNorm1d`` (reference ``main.py:29``).
+
+    Normalises over all axes but the last; keeps running stats with torch's
+    momentum convention (``new = (1-m)*old + m*batch``, m=0.1, eps=1e-5).
+
+    SPMD note (SURVEY §7 hard part b): stats are computed over the *local*
+    shard only — per-replica stats — which is exactly the reference's
+    behaviour (DDP syncs gradients, not BN buffers). A cross-replica ``pmean``
+    variant would be a behaviour change, so it's opt-in via ``axis_name``.
+    """
+
+    num_features: int
+    momentum: float = 0.1
+    eps: float = 1e-5
+    axis_name: str | tuple[str, ...] | None = None  # set to sync stats cross-replica
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        del key
+        f = self.num_features
+        return {"scale": jnp.ones((f,), self.param_dtype),
+                "bias": jnp.zeros((f,), self.param_dtype)}
+
+    def init_state(self):
+        f = self.num_features
+        return {"mean": jnp.zeros((f,), jnp.float32),
+                "var": jnp.ones((f,), jnp.float32)}
+
+    def apply(self, params, state, x, train: bool):
+        reduce_axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, reduce_axes)
+            var = jnp.var(x, reduce_axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                # E[x^2] - E[x]^2 with pmean'd moments for a true global var
+                ex2 = lax.pmean(jnp.mean(jnp.square(x), reduce_axes), self.axis_name)
+                var = ex2 - jnp.square(mean)
+            n = x.size // x.shape[-1]
+            unbiased = var * (n / max(n - 1, 1))
+            new_state = {
+                "mean": (1 - self.momentum) * state["mean"]
+                        + self.momentum * mean.astype(jnp.float32),
+                "var": (1 - self.momentum) * state["var"]
+                       + self.momentum * unbiased.astype(jnp.float32),
+            }
+        else:
+            mean, var = state["mean"].astype(x.dtype), state["var"].astype(x.dtype)
+            new_state = state
+        inv = lax.rsqrt(var.astype(x.dtype) + self.eps)
+        y = (x - mean.astype(x.dtype)) * inv
+        y = y * params["scale"].astype(x.dtype) + params["bias"].astype(x.dtype)
+        return y, new_state
+
+
+@dataclass(frozen=True)
+class LayerNorm:
+    """Layer normalisation over the last axis (transformer rungs)."""
+
+    num_features: int
+    eps: float = 1e-5
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        del key
+        return {"scale": jnp.ones((self.num_features,), self.param_dtype),
+                "bias": jnp.zeros((self.num_features,), self.param_dtype)}
+
+    def apply(self, params, x):
+        mean = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        return y * params["scale"].astype(x.dtype) + params["bias"].astype(x.dtype)
+
+
+@dataclass(frozen=True)
+class Embedding:
+    """Token/position embedding table."""
+
+    vocab_size: int
+    features: int
+    param_dtype: jnp.dtype = jnp.float32
+    init_std: float = 0.02
+
+    def init(self, key):
+        return {"embedding": self.init_std * jax.random.normal(
+            key, (self.vocab_size, self.features), self.param_dtype)}
+
+    def apply(self, params, ids):
+        return params["embedding"][ids]
+
+    def attend(self, params, x):
+        """Tied-softmax readout: ``x @ E^T``."""
+        return x @ params["embedding"].astype(x.dtype).T
+
+
+def log_softmax(x, axis: int = -1):
+    """``F.log_softmax`` equivalent (reference ``main.py:44``)."""
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def nll_loss(log_probs, targets, reduction: str = "mean"):
+    """``F.nll_loss`` equivalent (reference ``main.py:61,81``): negative
+    log-likelihood given *log-probabilities* and integer targets."""
+    picked = jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
+    if reduction == "mean":
+        return -picked.mean()
+    if reduction == "sum":
+        return -picked.sum()
+    return -picked
+
+
+def cross_entropy_with_logits(logits, targets, reduction: str = "mean"):
+    """Fused log_softmax + nll for the transformer rungs."""
+    return nll_loss(jax.nn.log_softmax(logits, -1), targets, reduction)

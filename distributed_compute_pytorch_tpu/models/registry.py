@@ -1,0 +1,25 @@
+"""Model registry — maps CLI names to model builders.
+
+The reference has exactly one hard-wired model (``main.py:20-45``); the
+framework's ladder (BASELINE.md configs 0-4) needs a zoo.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def build_model(name: str, **kw: Any):
+    if name == "convnet":
+        from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+        return ConvNet(**kw)
+    if name in ("resnet18", "resnet50"):
+        from distributed_compute_pytorch_tpu.models.resnet import ResNet
+        return ResNet.build(name, **kw)
+    if name == "bert":
+        from distributed_compute_pytorch_tpu.models.bert import BertMLM, BertConfig
+        return BertMLM(BertConfig(**kw))
+    if name == "gpt2":
+        from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+        return GPT2(GPT2Config(**kw))
+    raise ValueError(f"unknown model {name!r}")

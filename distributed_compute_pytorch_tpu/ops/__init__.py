@@ -1,0 +1,2 @@
+"""Numerical ops: attention, fused ops, Pallas TPU kernels (with XLA
+fallbacks so every op also runs on CPU meshes in tests)."""

@@ -1,0 +1,15 @@
+"""Parallelism strategies: partition specs over the named mesh.
+
+The reference's only strategy is gradient-averaging data parallelism via the
+DDP wrapper (``/root/reference/main.py:122``). Here parallelism is data: how
+each tensor is laid out over mesh axes — XLA inserts the collectives.
+"""
+
+from distributed_compute_pytorch_tpu.parallel.api import (
+    DataParallel,
+    FSDP,
+    ShardingRules,
+    shard_pytree,
+)
+
+__all__ = ["DataParallel", "FSDP", "ShardingRules", "shard_pytree"]

@@ -1,0 +1,138 @@
+"""Partition strategies: how params/optimizer state are laid out on the mesh.
+
+A strategy maps every parameter leaf to a ``PartitionSpec``. The jitted step
+function then runs with those shardings; XLA's SPMD partitioner inserts the
+collectives the layout implies:
+
+- **DataParallel** — params replicated, batch sharded over ``data``;
+  the gradient all-reduce the reference got from DDP's backward hooks
+  (``main.py:122``) becomes a compiled ``psum`` fused into the step.
+- **FSDP** — params sharded over the ``fsdp`` axis (ZeRO-3 style): XLA
+  all-gathers params per layer for compute and reduce-scatters grads;
+  optimizer state inherits the same sharding, so memory per chip is
+  O(params / fsdp). This is ``BASELINE.json`` configs[4]'s "XLA FSDP".
+- **ShardingRules** — regex path -> PartitionSpec table for model-specific
+  layouts (tensor parallelism for the transformer rungs lives here).
+
+All strategies compose: e.g. mesh ``data=2,fsdp=4`` gives 8-way batch
+sharding with 4-way parameter sharding.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+
+def _path_str(path) -> str:
+    """'conv1/kernel'-style string for a jax key path."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclass(frozen=True)
+class DataParallel:
+    """Pure DP: replicate every parameter (reference parity strategy)."""
+
+    def spec_for(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
+        del path, shape, mesh
+        return P()
+
+
+@dataclass(frozen=True)
+class FSDP:
+    """ZeRO-3-style parameter sharding along ``axis``.
+
+    Each leaf is sharded on the *largest* dimension divisible by the axis
+    size (a simple, effective heuristic — biggest dim gives the most even
+    memory split); leaves too small to shard stay replicated. Matching
+    optimizer state shards identically because it is laid out with the same
+    specs (see ``train/step.py``).
+    """
+
+    axis: str = "fsdp"
+    min_size_to_shard: int = 1024  # tiny leaves (biases, norms) stay replicated
+
+    def spec_for(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
+        del path
+        if self.axis not in mesh.axis_names:
+            return P()
+        n = mesh.shape[self.axis]
+        if n <= 1 or int(np.prod(shape)) < self.min_size_to_shard:
+            return P()
+        # largest divisible dim wins; ties -> earliest
+        best, best_dim = -1, None
+        for d, s in enumerate(shape):
+            if s % n == 0 and s > best:
+                best, best_dim = s, d
+        if best_dim is None:
+            return P()
+        spec = [None] * len(shape)
+        spec[best_dim] = self.axis
+        return P(*spec)
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Ordered ``(path_regex, PartitionSpec)`` table; first match wins.
+
+    Used by the transformer models to express Megatron-style tensor
+    parallelism (column-parallel QKV/MLP-in over ``tensor``, row-parallel
+    proj/MLP-out), optionally stacked on FSDP via ``fallback``.
+    """
+
+    rules: tuple[tuple[str, P], ...]
+    fallback: Any = field(default_factory=DataParallel)
+
+    def spec_for(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                # drop axes not in this mesh (lets one rule set serve many
+                # mesh shapes)
+                cleaned = []
+                for entry in spec:
+                    if entry is None:
+                        cleaned.append(None)
+                    elif isinstance(entry, (tuple, list)):
+                        kept = tuple(a for a in entry if a in mesh.axis_names
+                                     and mesh.shape[a] > 1)
+                        cleaned.append(kept if kept else None)
+                    else:
+                        cleaned.append(entry if entry in mesh.axis_names
+                                       and mesh.shape[entry] > 1 else None)
+                return P(*cleaned)
+        return self.fallback.spec_for(path, shape, mesh)
+
+
+def tree_specs(strategy, params: PyTree, mesh: Mesh) -> PyTree:
+    """PartitionSpec pytree matching ``params``' structure."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: strategy.spec_for(_path_str(path),
+                                             np.shape(leaf), mesh),
+        params)
+
+
+def tree_shardings(strategy, params: PyTree, mesh: Mesh) -> PyTree:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        tree_specs(strategy, params, mesh))
+
+
+def shard_pytree(params: PyTree, strategy, mesh: Mesh) -> PyTree:
+    """Place an (unsharded, host or single-device) pytree onto the mesh with
+    the strategy's layout."""
+    shardings = tree_shardings(strategy, params, mesh)
+    return jax.tree.map(jax.device_put, params, shardings)

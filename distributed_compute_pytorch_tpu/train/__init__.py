@@ -1,0 +1,10 @@
+"""Training subsystem: optimizer/schedule, SPMD step functions, trainer loop,
+metrics, checkpointing."""
+
+from distributed_compute_pytorch_tpu.train.optim import adadelta_steplr, build_optimizer
+from distributed_compute_pytorch_tpu.train.step import TrainState, make_step_fns
+from distributed_compute_pytorch_tpu.train.trainer import Trainer
+from distributed_compute_pytorch_tpu.train import checkpoint
+
+__all__ = ["adadelta_steplr", "build_optimizer", "TrainState", "make_step_fns",
+           "Trainer", "checkpoint"]

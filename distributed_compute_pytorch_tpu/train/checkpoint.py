@@ -1,0 +1,120 @@
+"""Checkpoint save/restore.
+
+The reference saves once, at end of training, from *every* rank to the same
+path (``/root/reference/main.py:133`` — a write race, SURVEY §A.6) and has no
+restore path at all. Here (SURVEY §5.4):
+
+- exactly one logical writer (the coordinator process),
+- a stable schema independent of the parallelism strategy (arrays are saved
+  unsharded, so a checkpoint written under FSDP restores under pure DP and
+  vice versa),
+- a restore path, including restore-into-sharded-layout.
+
+Format: a single ``.npz`` of path-flattened leaves plus a JSON manifest
+(step/epoch/format version) — no framework-specific pickle, loadable with
+plain numpy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_compute_pytorch_tpu.core.mesh import is_coordinator
+
+PyTree = Any
+_FORMAT_VERSION = 1
+_SEP = "::"
+
+
+def _flatten(tree: PyTree) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _SEP.join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _gather_host(tree: PyTree) -> PyTree:
+    """Bring every leaf to host, unsharded.
+
+    For multi-host sharded arrays (some shards not addressable locally),
+    all-gather via a replicated device_put first.
+    """
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(
+                x, tiled=True))
+        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return np.asarray(jax.random.key_data(x))
+        return np.asarray(x)
+    return jax.tree.map(fetch, tree)
+
+
+def save(path: str, state, *, epoch: int = 0, extra: dict | None = None) -> None:
+    """Write ``state`` (a TrainState or any pytree) to ``path``.
+
+    Coordinator-only write with atomic rename — the fix for the reference's
+    every-rank-writes race (``main.py:133``).
+    """
+    host_tree = _gather_host(state)   # collective: all processes participate
+    if not is_coordinator():
+        return
+    flat = _flatten(host_tree)
+    manifest = {"format": _FORMAT_VERSION, "epoch": epoch,
+                "extra": extra or {}}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __manifest__=json.dumps(manifest), **flat)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_manifest(path: str) -> dict:
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["__manifest__"]))
+
+
+def restore(path: str, template, shardings=None):
+    """Read a checkpoint back into ``template``'s pytree structure.
+
+    ``template`` provides structure/dtypes (e.g. a freshly-initialised
+    TrainState); ``shardings`` (optional, same structure) places each leaf
+    directly into its mesh layout — restore-into-FSDP works without ever
+    materialising the full model on one device per leaf batch.
+    """
+    with np.load(path, allow_pickle=False) as z:
+        flat = {k: z[k] for k in z.files if k != "__manifest__"}
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    flat_shardings = (jax.tree_util.tree_leaves(shardings)
+                      if shardings is not None else [None] * len(paths))
+    for (path_keys, leaf), shard in zip(paths, flat_shardings):
+        key = _SEP.join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path_keys)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        arr = flat[key]
+        if isinstance(leaf, jax.Array) and jnp.issubdtype(
+                leaf.dtype, jax.dtypes.prng_key):
+            new = jax.random.wrap_key_data(jnp.asarray(arr))
+        else:
+            new = jnp.asarray(arr, dtype=getattr(leaf, "dtype", None))
+        if shard is not None:
+            new = jax.device_put(new, shard)
+        leaves.append(new)
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,67 @@
+"""Optimizers and LR schedules.
+
+Reference parity targets (``/root/reference/main.py:124-125,131``):
+``optim.Adadelta(lr=opt.lr)`` (default 0.001 — note torch Adadelta's own
+default is 1.0; the reference overrides it) and ``StepLR(step_size=1,
+gamma=opt.gamma)`` stepped once per epoch, i.e. ``lr(epoch) = lr0 *
+gamma**epoch``.
+
+Torch Adadelta recurrence (what optax.scale_by_adadelta also implements):
+
+    E[g^2]   <- rho E[g^2] + (1-rho) g^2
+    dx       = sqrt(E[dx^2]+eps) / sqrt(E[g^2]+eps) * g
+    E[dx^2]  <- rho E[dx^2] + (1-rho) dx^2
+    x        <- x - lr * dx
+
+with rho=0.9, eps=1e-6 defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import optax
+
+
+def steplr(base_lr: float, gamma: float, steps_per_epoch: int) -> Callable[[int], float]:
+    """``StepLR(step_size=1, gamma)`` as an optax step-indexed schedule.
+
+    The reference steps its scheduler once per epoch (``main.py:131``); under
+    a single jitted step we index by global step and divide out
+    ``steps_per_epoch``.
+    """
+    def schedule(step):
+        epoch = step // steps_per_epoch
+        return base_lr * (gamma ** epoch)
+    return schedule
+
+
+def adadelta_steplr(lr: float, gamma: float, steps_per_epoch: int,
+                    rho: float = 0.9, eps: float = 1e-6) -> optax.GradientTransformation:
+    """The reference's exact optimizer stack: Adadelta(lr) + per-epoch decay."""
+    return optax.chain(
+        optax.scale_by_adadelta(rho=rho, eps=eps),
+        optax.scale_by_schedule(lambda s: -steplr(lr, gamma, steps_per_epoch)(s)),
+    )
+
+
+def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
+                    weight_decay: float = 0.0, warmup_steps: int = 0,
+                    **kw) -> optax.GradientTransformation:
+    """Registry for the model ladder: the reference stack for parity runs,
+    AdamW+warmup-cosine for the transformer rungs."""
+    if name == "adadelta":
+        return adadelta_steplr(lr, gamma, steps_per_epoch, **kw)
+    if name == "sgd":
+        return optax.chain(
+            optax.trace(decay=kw.pop("momentum", 0.9)),
+            optax.scale_by_schedule(lambda s: -steplr(lr, gamma, steps_per_epoch)(s)),
+        )
+    if name == "adamw":
+        total = kw.pop("total_steps", steps_per_epoch * 10)
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(total, warmup_steps + 1))
+        return optax.adamw(sched, weight_decay=weight_decay, **kw)
+    raise ValueError(f"unknown optimizer {name!r}")

@@ -1,0 +1,148 @@
+"""The compiled SPMD step functions.
+
+This single module replaces four reference components at once (SURVEY.md §7
+layer 4): the train loop body (``/root/reference/main.py:55-68``), the eval
+loop body (``main.py:70-95``), the DDP gradient sync (``main.py:122``) and the
+explicit metric all-reduces (``main.py:65,90,91``). Everything is one jitted
+function over the mesh:
+
+- the batch arrives sharded over the batch axes; params live wherever the
+  partition strategy put them;
+- gradients of replicated params are globally summed by XLA (the DDP
+  all-reduce, now fused into the compiled step and riding ICI);
+- metric outputs are unsharded scalars, so XLA inserts the cross-shard
+  reductions the reference did with ``dist.all_reduce(SUM)``.
+
+Host<->device discipline: step functions return device scalars that are only
+*read* at the logging cadence (every ``log_every`` steps, reference
+``main.py:64``), so the hot loop never blocks on transfers (SURVEY §7 hard
+part c).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
+from distributed_compute_pytorch_tpu.parallel.api import (
+    DataParallel, tree_shardings)
+
+PyTree = Any
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["step", "params", "model_state", "opt_state", "rng"],
+         meta_fields=[])
+@dataclass
+class TrainState:
+    """Everything that evolves during training, as one pytree.
+
+    The reference splits this across the DDP-wrapped module, the torch
+    optimizer and the scheduler (``main.py:118-125``); here it is a single
+    donated pytree so each step updates in place on device.
+    """
+
+    step: jax.Array          # global step counter (drives the LR schedule)
+    params: PyTree
+    model_state: PyTree      # e.g. BatchNorm running stats
+    opt_state: PyTree
+    rng: jax.Array           # base key; per-step keys are fold_in(rng, step)
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
+                  strategy=None, donate: bool = True):
+    """Build ``(init_fn, train_step, eval_step)`` for ``model`` on ``mesh``.
+
+    ``strategy`` decides parameter layout (default pure DP = replicated,
+    reference parity). The returned functions are jit-compiled with explicit
+    in/out shardings; train_step donates the state buffers.
+    """
+    strategy = strategy or DataParallel()
+
+    def _state_shardings(state_shapes: TrainState) -> TrainState:
+        repl = NamedSharding(mesh, P())
+        return TrainState(
+            step=repl,
+            params=tree_shardings(strategy, state_shapes.params, mesh),
+            model_state=jax.tree.map(lambda _: repl, state_shapes.model_state),
+            opt_state=tree_shardings(strategy, state_shapes.opt_state, mesh),
+            rng=repl,
+        )
+
+    def _init(key) -> TrainState:
+        params, model_state = model.init(key)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            model_state=model_state,
+            opt_state=tx.init(params),
+            rng=jax.random.key(0) if key is None else key,
+        )
+
+    def init_fn(key) -> TrainState:
+        """Initialise the train state directly into its mesh layout.
+
+        jit-with-out_shardings means FSDP params are *born sharded* — no
+        host-side full copy, which is what lets models larger than one chip's
+        HBM initialise at all.
+        """
+        shapes = jax.eval_shape(_init, key)
+        shardings = _state_shardings(shapes)
+        return jax.jit(_init, out_shardings=shardings)(key)
+
+    # NOTE: train/eval steps take their shardings from the *arrays* — init_fn
+    # commits the state to the strategy's layout and the DeviceFeeder commits
+    # batches to the batch axes, so jit sees fully-specified layouts and the
+    # SPMD partitioner inserts the implied collectives.
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state: TrainState, x, y):
+        """One optimization step == reference ``train`` body (``main.py:57-63``)."""
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            out, new_mstate = model.apply(params, state.model_state, x,
+                                          train=True, rng=step_rng)
+            loss = model.loss_fn(out, y)
+            return loss, new_mstate
+
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params,
+            model_state=new_mstate, opt_state=new_opt_state)
+        # global mean loss (the reference logs the SUM over ranks, a
+        # world-size-scaled number — SURVEY §A.4; we fix to the mean)
+        metrics = {"loss": loss.astype(jnp.float32)}
+        return new_state, metrics
+
+    @jax.jit
+    def eval_step(state: TrainState, x, y):
+        """Eval-batch metrics == reference ``test`` body (``main.py:78-86``).
+
+        Returns device-side sums; the cross-replica ``all_reduce(SUM)`` of
+        ``main.py:90-91`` is implicit in producing unsharded outputs.
+        """
+        out, _ = model.apply(state.params, state.model_state, x, train=False)
+        loss_sum = model.loss_sum(out, y) if hasattr(model, "loss_sum") else \
+            model.loss_fn(out, y) * x.shape[0]
+        pred = jnp.argmax(out, axis=-1)
+        correct = jnp.sum((pred == y).astype(jnp.int32))
+        return {"loss_sum": loss_sum.astype(jnp.float32),
+                "correct": correct,
+                "count": jnp.asarray(x.shape[0], jnp.int32)}
+
+    return init_fn, train_step, eval_step

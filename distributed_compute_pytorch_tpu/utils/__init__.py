@@ -1,0 +1,6 @@
+"""Utilities: coordinator-guarded logging, timers, profiling hooks."""
+
+from distributed_compute_pytorch_tpu.utils.logging import log0, MetricLogger
+from distributed_compute_pytorch_tpu.utils.timing import Timer
+
+__all__ = ["log0", "MetricLogger", "Timer"]

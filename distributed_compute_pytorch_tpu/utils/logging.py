@@ -1,0 +1,66 @@
+"""Coordinator-guarded structured logging.
+
+The reference prints aggregate lines under ``rank == 0`` guards
+(``/root/reference/main.py:66-68,93-95``) but leaks unguarded per-rank prints
+(``main.py:100,132``). Here every user-facing line goes through the
+coordinator guard, and metrics can additionally stream to a JSONL file for
+machine consumption (SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from distributed_compute_pytorch_tpu.core.mesh import is_coordinator
+
+
+def log0(*args, **kw) -> None:
+    """``print`` from the coordinator only (reference's rank-0 guard)."""
+    if is_coordinator():
+        print(*args, **kw)
+        sys.stdout.flush()
+
+
+class MetricLogger:
+    """stdout (reference cadence/format) + optional JSONL sink."""
+
+    def __init__(self, jsonl_path: str | None = None):
+        self._f = open(jsonl_path, "a") if (jsonl_path and is_coordinator()) else None
+
+    def train_line(self, epoch: int, step: int, steps_per_epoch: int,
+                   loss: float) -> None:
+        # same shape as reference main.py:67-68
+        pct = 100.0 * step / steps_per_epoch
+        log0(f"epoch: {epoch} [{step}/{steps_per_epoch} ({pct:.0f}%)]\t "
+             f"Loss:{loss:.6f}")
+        self._emit({"kind": "train", "epoch": epoch, "step": step,
+                    "loss": loss})
+
+    def eval_line(self, epoch: int, loss: float, correct: int, total: int) -> None:
+        # same shape as reference main.py:94-95, with the loss actually
+        # normalised (fixes SURVEY §A.5)
+        acc = 100.0 * correct / max(total, 1)
+        log0(f"\nTest set: Average loss: {loss:.4f}, "
+             f"Accuracy: {correct}/{total} ({acc:.0f}%)\n")
+        self._emit({"kind": "eval", "epoch": epoch, "loss": loss,
+                    "correct": correct, "total": total, "accuracy": acc})
+
+    def epoch_time(self, epoch: int, seconds: float, samples_per_sec: float) -> None:
+        # reference main.py:132 prints wall time; we add throughput (the
+        # north-star metric, BASELINE.md)
+        log0(f"time to complete this epoch: {seconds} seconds "
+             f"({samples_per_sec:.1f} samples/s)")
+        self._emit({"kind": "epoch", "epoch": epoch, "seconds": seconds,
+                    "samples_per_sec": samples_per_sec})
+
+    def _emit(self, rec: dict) -> None:
+        if self._f is not None:
+            rec["ts"] = time.time()
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()

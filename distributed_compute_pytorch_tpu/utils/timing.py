@@ -1,0 +1,32 @@
+"""Wall-clock timing (reference ``main.py:128,132``) and opt-in XLA profiling
+(SURVEY §5.1 — the reference has no profiler hooks at all)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class Timer:
+    """Epoch/step stopwatch matching the reference's ``time.time()`` pairs."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+
+    def reset(self) -> None:
+        self.t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.t0
+
+
+@contextlib.contextmanager
+def maybe_profile(profile_dir: str | None):
+    """Wrap a region in ``jax.profiler.trace`` when a directory is given."""
+    if profile_dir:
+        with jax.profiler.trace(profile_dir):
+            yield
+    else:
+        yield

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Per-host launcher — the role of reference cbasics.sh (conda activate +
+# CUDA_VISIBLE_DEVICES + python3 main.py), rebuilt for TPU pods.
+#
+# Single host (all local TPU chips):
+#   ./launch.sh
+# Multi-host: run on every worker (e.g. via
+#   gcloud compute tpus tpu-vm ssh $TPU --worker=all --command="cd ...; ./launch.sh")
+# with the rendezvous env set per worker:
+#   DCP_COORDINATOR=<worker0-ip>:8476 DCP_NUM_PROCESSES=<hosts> DCP_PROCESS_ID=<i>
+# On Cloud TPU VMs jax auto-discovers the pod topology, so the env block is
+# only needed off-GCP.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python3 train.py "$@"

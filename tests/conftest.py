@@ -1,0 +1,33 @@
+"""Test harness: fake an 8-device CPU mesh in one process.
+
+SURVEY.md §4: the reference has no tests; our multi-process collective tests
+run without a cluster via ``xla_force_host_platform_device_count`` — this
+must be set before JAX initialises its backends, hence here, before any test
+imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+# determinism + speed for CPU test runs
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# Environments that preload jax at interpreter startup (e.g. a TPU-plugin
+# sitecustomize) have already latched JAX_PLATFORMS from their own env; the
+# config update below wins as long as no backend has initialised yet.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 faked CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,84 @@
+"""Model library unit tests: shapes, dtypes, pure-function contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.models import layers as L
+from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+
+
+def test_convnet_shapes_match_reference_topology():
+    # reference main.py:20-45: 28x28x1 -> ... -> flatten 9216 -> 128 -> 10
+    model = ConvNet()
+    params, state = model.init(jax.random.key(0))
+    assert params["fc1"]["kernel"].shape == (9216, 128)
+    assert params["conv1"]["kernel"].shape == (3, 3, 1, 32)
+    x = jnp.zeros((4, 28, 28, 1))
+    logp, _ = model.apply(params, state, x, train=False)
+    assert logp.shape == (4, 10)
+    # log_softmax output: rows sum to 1 in prob space
+    np.testing.assert_allclose(np.exp(np.asarray(logp)).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_convnet_train_vs_eval_mode():
+    model = ConvNet()
+    params, state = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (8, 28, 28, 1))
+    e1, _ = model.apply(params, state, x, train=False)
+    e2, _ = model.apply(params, state, x, train=False)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))  # eval deterministic
+    t1, new_state = model.apply(params, state, x, train=True, rng=jax.random.key(2))
+    assert not np.array_equal(np.asarray(t1), np.asarray(e1))  # dropout active
+    # batchnorm state updated in train mode only
+    assert not np.array_equal(np.asarray(new_state["batchnorm"]["mean"]),
+                              np.asarray(state["batchnorm"]["mean"]))
+
+
+def test_batchnorm_matches_torch_semantics():
+    torch = pytest.importorskip("torch")
+    bn = L.BatchNorm(5)
+    params, state = bn.init(None), bn.init_state()
+    x = np.random.default_rng(0).normal(size=(16, 5)).astype(np.float32)
+    y, new_state = bn.apply(params, state, jnp.asarray(x), train=True)
+
+    tbn = torch.nn.BatchNorm1d(5)
+    ty = tbn(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["mean"]),
+                               tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["var"]),
+                               tbn.running_var.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_nll_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(12, 10)).astype(np.float32)
+    targets = rng.integers(0, 10, size=12)
+    logp = jax.nn.log_softmax(jnp.asarray(logits), -1)
+    ours = L.nll_loss(logp, jnp.asarray(targets), reduction="mean")
+    theirs = torch.nn.functional.nll_loss(
+        torch.log_softmax(torch.tensor(logits), -1), torch.tensor(targets))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    conv = L.Conv2d(3, 8, 3, 1)
+    params = conv.init(jax.random.key(0))
+    x = np.random.default_rng(2).normal(size=(2, 9, 9, 3)).astype(np.float32)
+    y = conv.apply(params, jnp.asarray(x))
+
+    tconv = torch.nn.Conv2d(3, 8, 3, 1)
+    with torch.no_grad():
+        # HWIO -> OIHW
+        tconv.weight.copy_(torch.tensor(
+            np.transpose(np.asarray(params["kernel"]), (3, 2, 0, 1))))
+        tconv.bias.copy_(torch.tensor(np.asarray(params["bias"])))
+        ty = tconv(torch.tensor(np.transpose(x, (0, 3, 1, 2))))
+    np.testing.assert_allclose(np.asarray(y),
+                               np.transpose(ty.numpy(), (0, 2, 3, 1)),
+                               rtol=1e-4, atol=1e-5)
