@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Headline benchmark — run by the driver on real TPU hardware.
+
+North-star metric (BASELINE.json): samples/sec/chip training the reference's
+default model (the MNIST ConvNet of ``/root/reference/main.py:20-45``) at the
+reference's default global batch size (128, ``main.py:139``) with the
+reference optimizer stack (Adadelta lr=1e-3 + StepLR). ``vs_baseline``
+compares against the measured reference-semantics torch CPU number in
+``benchmarks/baseline_measured.json`` (the reference publishes no numbers —
+BASELINE.md).
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh, batch_sharding
+    from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+    from distributed_compute_pytorch_tpu.train.optim import adadelta_steplr
+    from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = make_mesh("data=-1", devices=devices)
+
+    batch = 128  # reference default (main.py:139)
+    model = ConvNet()
+    tx = adadelta_steplr(lr=1e-3, gamma=0.7, steps_per_epoch=469)
+    init_fn, train_step, _ = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(0))
+
+    shard_x = batch_sharding(mesh, 4)
+    shard_y = batch_sharding(mesh, 1)
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(1), (batch, 28, 28, 1), jnp.float32),
+        shard_x)
+    y = jax.device_put(
+        jax.random.randint(jax.random.key(2), (batch,), 0, 10, jnp.int32),
+        shard_y)
+
+    # warmup (includes compile)
+    for _ in range(10):
+        state, metrics = train_step(state, x, y)
+    jax.block_until_ready(state.params)
+
+    iters = 200
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = train_step(state, x, y)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    sps_per_chip = batch * iters / dt / n_chips
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks", "baseline_measured.json")
+    with open(base_path) as f:
+        base = json.load(f)["mnist_convnet_train_samples_per_sec"]["value"]
+
+    print(json.dumps({
+        "metric": "mnist_convnet_train_samples_per_sec_per_chip",
+        "value": round(sps_per_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_per_chip / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

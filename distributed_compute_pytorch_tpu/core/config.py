@@ -47,7 +47,9 @@ class Config:
 
     # --- model / task selection (the reference has one model; we have a zoo) ---
     model: str = "convnet"         # convnet | resnet18 | resnet50 | bert | gpt2
+    model_preset: str | None = None  # e.g. 'tiny' for test-scale transformers
     dataset: str = "mnist"         # mnist | cifar10 | synthetic-images | synthetic-lm
+    optimizer: str = "adadelta"    # adadelta (reference stack) | sgd | adamw
 
     # --- logging / metrics (cadence matches main.py:64) ---
     log_every: int = 10            # print a loss line every N steps (main.py:64)
@@ -55,7 +57,7 @@ class Config:
 
     # --- data / checkpoint paths ---
     data_dir: str = "./data"       # reference uses './data/' (main.py:107)
-    ckpt_path: str = "checkpoint.msgpack"  # reference writes 'mnist.pt' (main.py:133)
+    ckpt_path: str = "checkpoint.npz"  # reference writes 'mnist.pt' (main.py:133)
     resume: bool = False           # restore path the reference lacks (SURVEY §5.4)
 
     # --- distributed rendezvous (replaces main.py:48-49 hard-coding) ---
@@ -77,15 +79,10 @@ class Config:
     eval_on_train: bool = False
 
     def mesh_axes(self) -> dict[str, int]:
-        """Parse the mesh spec string into an ordered ``{axis: size}`` dict."""
-        axes: dict[str, int] = {}
-        for part in self.mesh.split(","):
-            part = part.strip()
-            if not part:
-                continue
-            name, _, size = part.partition("=")
-            axes[name.strip()] = int(size) if size else -1
-        return axes or {"data": -1}
+        """Parse the mesh spec string into an ordered ``{axis: size}`` dict
+        (delegates to MeshSpec so axis-name validation happens in one place)."""
+        from distributed_compute_pytorch_tpu.core.mesh import MeshSpec
+        return dict(MeshSpec.parse(self.mesh).axes)
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -107,7 +104,11 @@ class Config:
         p.add_argument("--mesh", type=str, default=cls.mesh,
                        help="device mesh spec, e.g. 'data=8' or 'data=2,fsdp=4'")
         p.add_argument("--model", type=str, default=cls.model)
+        p.add_argument("--model_preset", type=str, default=None,
+                       help="e.g. 'tiny' for test-scale transformers")
         p.add_argument("--dataset", type=str, default=cls.dataset)
+        p.add_argument("--optimizer", type=str, default=cls.optimizer,
+                       help="adadelta (reference stack) | sgd | adamw")
         p.add_argument("--log_every", type=int, default=cls.log_every)
         p.add_argument("--seed", type=int, default=cls.seed)
         p.add_argument("--data_dir", type=str, default=cls.data_dir)

@@ -161,6 +161,29 @@ def make_mesh(spec: str | dict[str, int] | MeshSpec = "data=-1",
     return Mesh(dev_array, axis_names=spec.names)
 
 
+_mesh_stack: list[Mesh] = []
+
+
+class use_mesh:
+    """Context manager establishing the *current* mesh, so layers deep inside
+    a model (e.g. ring attention picking its ``seq`` axis) can find the mesh
+    without threading it through every call signature."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self) -> Mesh:
+        _mesh_stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc) -> None:
+        _mesh_stack.pop()
+
+
+def current_mesh() -> Mesh | None:
+    return _mesh_stack[-1] if _mesh_stack else None
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Sharding for a global batch: leading dim split over the batch axes
     present in ``mesh``, remaining dims replicated. The SPMD analogue of the

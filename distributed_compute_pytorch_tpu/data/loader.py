@@ -17,7 +17,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
+from distributed_compute_pytorch_tpu.core.mesh import batch_sharding, local_batch_size
 from distributed_compute_pytorch_tpu.data.datasets import ArrayDataset
 from distributed_compute_pytorch_tpu.data.sampler import ShardedSampler
 
@@ -51,11 +51,31 @@ class DeviceFeeder:
         self.dataset = dataset
         self.mesh = mesh
         self.global_batch = global_batch
+        local_batch_size(global_batch, mesh)  # raises clearly if not divisible
         self.sampler = ShardedSampler(
             num_examples=len(dataset), global_batch=global_batch,
             shuffle=shuffle, seed=seed, drop_last=drop_last)
-        self.input_sharding = batch_sharding(mesh, dataset.inputs.ndim)
-        self.target_sharding = batch_sharding(mesh, dataset.targets.ndim)
+        self.input_sharding = self._sharding_for(dataset.inputs.ndim)
+        self.target_sharding = self._sharding_for(dataset.targets.ndim)
+
+    def _sharding_for(self, ndim: int) -> NamedSharding:
+        """Batch dim over the batch axes; for token arrays ``[B, T]`` the
+        sequence dim additionally shards over ``seq`` (context parallelism).
+        Multi-host note: keep the ``seq`` axis within a host (mesh axis order
+        puts batch axes outermost) so each process still feeds contiguous
+        batch rows."""
+        base = batch_sharding(self.mesh, ndim)
+        if (ndim == 2 and "seq" in self.mesh.axis_names
+                and self.mesh.shape["seq"] > 1):
+            seq_len = self.dataset.inputs.shape[1]
+            n_seq = self.mesh.shape["seq"]
+            if seq_len % n_seq:
+                raise ValueError(
+                    f"sequence length {seq_len} not divisible by seq axis "
+                    f"size {n_seq}")
+            batch_spec = base.spec[0]
+            return NamedSharding(self.mesh, P(batch_spec, "seq"))
+        return base
 
     def __len__(self) -> int:
         return self.sampler.num_batches

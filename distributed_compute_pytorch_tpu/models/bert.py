@@ -1,0 +1,136 @@
+"""BERT (bidirectional encoder, masked-LM objective) — BASELINE.md ladder
+rung 3 ("BERT-base MLM fine-tune", ``BASELINE.json`` configs[3]).
+
+Standard BERT-base topology: token + learned-position embeddings with
+embedding LayerNorm, post-LN transformer blocks with bidirectional attention,
+and an MLM head (dense + gelu + LN + tied-embedding readout). Defaults are
+BERT-base (12 layers, 12 heads, 768); everything scales down for tests.
+
+The MLM objective is self-contained: ``train_loss`` derives the 15% masking
+from the step rng (80% [MASK] / 10% random / 10% keep, BERT's recipe), so
+the data pipeline just supplies token sequences — no pre-masked dataset
+needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from distributed_compute_pytorch_tpu.models import layers as L
+from distributed_compute_pytorch_tpu.models.transformer import (
+    TransformerBlock, tp_partition_rules)
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dropout_rate: float = 0.1
+    mask_rate: float = 0.15
+    mask_token_id: int = 103       # [MASK] in the WordPiece vocab
+    param_dtype: jnp.dtype = jnp.float32
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=256, max_seq_len=64, num_layers=2, num_heads=4,
+                   d_model=64, d_ff=128, dropout_rate=0.0, mask_token_id=1)
+
+
+@dataclass(frozen=True)
+class BertMLM:
+    config: BertConfig = BertConfig()
+
+    def _block(self) -> TransformerBlock:
+        c = self.config
+        return TransformerBlock(c.d_model, c.num_heads, c.d_ff,
+                                c.dropout_rate, pre_ln=False, causal=False,
+                                param_dtype=c.param_dtype)
+
+    def init(self, key):
+        c = self.config
+        ks = jax.random.split(key, c.num_layers + 3)
+        wte = L.Embedding(c.vocab_size, c.d_model, param_dtype=c.param_dtype)
+        wpe = L.Embedding(c.max_seq_len, c.d_model, param_dtype=c.param_dtype,
+                          init_std=0.01)
+        block = self._block()
+        params = {
+            "wte": wte.init(ks[0]),
+            "wpe": wpe.init(ks[1]),
+            "emb_ln": L.LayerNorm(c.d_model).init(None),
+            "blocks": [block.init(ks[2 + i]) for i in range(c.num_layers)],
+            "mlm_dense": L.Dense(c.d_model, c.d_model,
+                                 param_dtype=c.param_dtype).init(ks[-1]),
+            "mlm_ln": L.LayerNorm(c.d_model).init(None),
+        }
+        return params, {}
+
+    def apply(self, params, state, tokens, *, train: bool = False, rng=None):
+        """``tokens [B, T] int32`` -> MLM logits ``[B, T, vocab]``."""
+        c = self.config
+        wte = L.Embedding(c.vocab_size, c.d_model)
+        wpe = L.Embedding(c.max_seq_len, c.d_model)
+        T = tokens.shape[1]
+        x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"],
+                                                         jnp.arange(T))
+        x = L.LayerNorm(c.d_model).apply(params["emb_ln"], x)
+        if train and rng is not None:
+            rngs = jax.random.split(rng, c.num_layers + 1)
+            x = L.dropout(x, c.dropout_rate, rngs[0], train)
+        else:
+            rngs = [None] * (c.num_layers + 1)
+        block = self._block()
+        for i in range(c.num_layers):
+            x = block.apply(params["blocks"][i], x, rng=rngs[i + 1],
+                            train=train)
+        h = L.Dense(c.d_model, c.d_model).apply(params["mlm_dense"], x)
+        h = jax.nn.gelu(h)
+        h = L.LayerNorm(c.d_model).apply(params["mlm_ln"], h)
+        logits = wte.attend(params["wte"], h)
+        return logits, state
+
+    # --- MLM objective (masking derived from the step rng) ---
+
+    def _mask_inputs(self, tokens, rng):
+        c = self.config
+        r_sel, r_kind, r_rand = jax.random.split(rng, 3)
+        selected = jax.random.bernoulli(r_sel, c.mask_rate, tokens.shape)
+        kind = jax.random.uniform(r_kind, tokens.shape)
+        random_tok = jax.random.randint(r_rand, tokens.shape, 0, c.vocab_size)
+        masked = jnp.where(kind < 0.8, c.mask_token_id,
+                           jnp.where(kind < 0.9, random_tok, tokens))
+        inputs = jnp.where(selected, masked, tokens)
+        return inputs, selected
+
+    def train_loss(self, params, model_state, tokens, targets, rng,
+                   train: bool = True):
+        """step.py train protocol: masked-position cross-entropy."""
+        del targets  # MLM targets are the unmasked tokens themselves
+        r_mask, r_drop = jax.random.split(rng)
+        inputs, selected = self._mask_inputs(tokens, r_mask)
+        logits, new_state = self.apply(params, model_state, inputs,
+                                       train=train, rng=r_drop)
+        per_tok = L.cross_entropy_with_logits(logits, tokens, "none")
+        n_sel = jnp.maximum(selected.sum(), 1)
+        loss = jnp.sum(per_tok * selected) / n_sel
+        return loss, new_state
+
+    def eval_metrics(self, logits, tokens):
+        """Eval without masking randomness: score all positions (a stable
+        pseudo-perplexity proxy)."""
+        pred = jnp.argmax(logits, axis=-1)
+        return {
+            "loss_sum": L.cross_entropy_with_logits(
+                logits, tokens, "sum").astype(jnp.float32),
+            "correct": jnp.sum((pred == tokens).astype(jnp.int32)),
+            "count": jnp.asarray(tokens.size, jnp.int32),
+        }
+
+    def partition_rules(self):
+        return tp_partition_rules()

@@ -24,16 +24,21 @@ from distributed_compute_pytorch_tpu.models import layers as L
 class ConvNet:
     num_classes: int = 10
     in_channels: int = 1
+    image_size: tuple[int, int] = (28, 28)
     param_dtype: jnp.dtype = jnp.float32
 
     def __post_init__(self):
+        # two valid 3x3 convs shave 4 px, then maxpool(2) halves: at the
+        # reference's 28x28x1 this is 12*12*64 = 9216 (main.py:27)
+        h, w = self.image_size
+        flat = ((h - 4) // 2) * ((w - 4) // 2) * 64
         object.__setattr__(self, "conv1",
                            L.Conv2d(self.in_channels, 32, 3, 1,
                                     param_dtype=self.param_dtype))
         object.__setattr__(self, "conv2",
                            L.Conv2d(32, 64, 3, 1, param_dtype=self.param_dtype))
         object.__setattr__(self, "fc1",
-                           L.Dense(9216, 128, param_dtype=self.param_dtype))
+                           L.Dense(flat, 128, param_dtype=self.param_dtype))
         object.__setattr__(self, "fc2",
                            L.Dense(128, self.num_classes,
                                    param_dtype=self.param_dtype))

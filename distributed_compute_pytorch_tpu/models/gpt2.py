@@ -1,0 +1,117 @@
+"""GPT-2 (decoder-only causal LM) — BASELINE.md ladder rung 4
+("GPT-2-small with XLA FSDP", ``BASELINE.json`` configs[4]).
+
+Standard GPT-2 topology: learned token + position embeddings, pre-LN
+transformer blocks with fused-QKV causal attention, final LayerNorm, and a
+weight-tied readout through the token embedding. Sizes default to GPT-2-small
+(12 layers, 12 heads, 768 d_model, 50257 vocab) but every dimension is a
+config knob so tests run tiny.
+
+Parallelism: ``partition_rules()`` provides the Megatron TP layout for the
+block weights (see ``models/transformer.py``); pair with the ``fsdp`` axis
+for FSDP and with ``seq`` + ``parallel/ring_attention`` for long context.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from distributed_compute_pytorch_tpu.models import layers as L
+from distributed_compute_pytorch_tpu.models.transformer import (
+    TransformerBlock, tp_partition_rules)
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dropout_rate: float = 0.1
+    param_dtype: jnp.dtype = jnp.float32
+
+    @classmethod
+    def small(cls) -> "GPT2Config":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "GPT2Config":
+        """For tests/dryruns: real topology, toy sizes (multiples of mesh
+        axes so every sharding strategy applies)."""
+        return cls(vocab_size=256, max_seq_len=64, num_layers=2,
+                   num_heads=4, d_model=64, d_ff=128, dropout_rate=0.0)
+
+
+@dataclass(frozen=True)
+class GPT2:
+    config: GPT2Config = GPT2Config()
+
+    def _block(self) -> TransformerBlock:
+        c = self.config
+        return TransformerBlock(c.d_model, c.num_heads, c.d_ff,
+                                c.dropout_rate, pre_ln=True, causal=True,
+                                param_dtype=c.param_dtype)
+
+    def init(self, key):
+        c = self.config
+        ks = jax.random.split(key, c.num_layers + 2)
+        wte = L.Embedding(c.vocab_size, c.d_model, param_dtype=c.param_dtype)
+        wpe = L.Embedding(c.max_seq_len, c.d_model, param_dtype=c.param_dtype,
+                          init_std=0.01)
+        block = self._block()
+        params = {
+            "wte": wte.init(ks[0]),
+            "wpe": wpe.init(ks[1]),
+            "blocks": [block.init(ks[2 + i]) for i in range(c.num_layers)],
+            "ln_f": L.LayerNorm(c.d_model).init(None),
+        }
+        return params, {}   # no batch-stat state in transformers
+
+    def apply(self, params, state, tokens, *, train: bool = False, rng=None):
+        """``tokens [B, T] int32`` -> logits ``[B, T, vocab]``."""
+        c = self.config
+        wte = L.Embedding(c.vocab_size, c.d_model)
+        wpe = L.Embedding(c.max_seq_len, c.d_model)
+        T = tokens.shape[1]
+        pos = jnp.arange(T)
+        x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"], pos)
+        if train and rng is not None:
+            rngs = jax.random.split(rng, c.num_layers + 1)
+            x = L.dropout(x, c.dropout_rate, rngs[0], train)
+        else:
+            rngs = [None] * (c.num_layers + 1)
+        block = self._block()
+        for i in range(c.num_layers):
+            x = block.apply(params["blocks"][i], x, rng=rngs[i + 1],
+                            train=train)
+        x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
+        logits = wte.attend(params["wte"], x)  # weight-tied readout
+        return logits, state
+
+    # --- loss protocol (next-token prediction: shift inside) ---
+
+    def loss_fn(self, logits, tokens):
+        return L.cross_entropy_with_logits(logits[:, :-1], tokens[:, 1:],
+                                           "mean")
+
+    def loss_sum(self, logits, tokens):
+        return L.cross_entropy_with_logits(logits[:, :-1], tokens[:, 1:],
+                                           "sum")
+
+    def eval_metrics(self, logits, tokens):
+        """Token-level sums for eval aggregation (step.py eval protocol)."""
+        pred = jnp.argmax(logits[:, :-1], axis=-1)
+        tgt = tokens[:, 1:]
+        return {
+            "loss_sum": self.loss_sum(logits, tokens).astype(jnp.float32),
+            "correct": jnp.sum((pred == tgt).astype(jnp.int32)),
+            "count": jnp.asarray(tgt.size, jnp.int32),
+        }
+
+    def partition_rules(self):
+        return tp_partition_rules()

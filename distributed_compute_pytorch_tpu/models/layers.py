@@ -107,13 +107,17 @@ class Conv2d:
         return y
 
 
-def max_pool2d(x, window: int = 2, stride: int | None = None):
-    """``F.max_pool2d`` equivalent (reference ``main.py:36``), NHWC."""
+def max_pool2d(x, window: int = 2, stride: int | None = None, padding: int = 0):
+    """``F.max_pool2d`` equivalent (reference ``main.py:36``), NHWC.
+
+    ``padding`` is symmetric spatial padding in pixels (torch convention).
+    """
     stride = stride or window
+    pads = ((0, 0), (padding, padding), (padding, padding), (0, 0))
     return lax.reduce_window(
         x, -jnp.inf, lax.max,
         window_dimensions=(1, window, window, 1),
-        window_strides=(1, stride, stride, 1), padding="VALID")
+        window_strides=(1, stride, stride, 1), padding=pads)
 
 
 def avg_pool2d(x, window: int = 2, stride: int | None = None):

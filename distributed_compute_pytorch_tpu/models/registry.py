@@ -6,7 +6,22 @@ framework's ladder (BASELINE.md configs 0-4) needs a zoo.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
+
+
+def _transformer_config(cfg_cls, default_cfg, kw: dict):
+    """Shared preset + override plumbing for the transformer configs."""
+    preset = kw.pop("preset", None)
+    if preset in (None, "full", "base", "small"):
+        cfg = default_cfg
+    elif preset == "tiny":
+        cfg = cfg_cls.tiny()
+    else:
+        raise ValueError(
+            f"unknown {cfg_cls.__name__} preset {preset!r}; "
+            f"expected 'tiny' or None")
+    return dataclasses.replace(cfg, **kw)
 
 
 def build_model(name: str, **kw: Any):
@@ -18,8 +33,8 @@ def build_model(name: str, **kw: Any):
         return ResNet.build(name, **kw)
     if name == "bert":
         from distributed_compute_pytorch_tpu.models.bert import BertMLM, BertConfig
-        return BertMLM(BertConfig(**kw))
+        return BertMLM(_transformer_config(BertConfig, BertConfig(), kw))
     if name == "gpt2":
         from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
-        return GPT2(GPT2Config(**kw))
+        return GPT2(_transformer_config(GPT2Config, GPT2Config.small(), kw))
     raise ValueError(f"unknown model {name!r}")

@@ -1,0 +1,180 @@
+"""ResNet-18/50 — BASELINE.md ladder rungs 1-2 (CIFAR-10 / ImageNet).
+
+The reference repo has no ResNet (its only model is the MNIST ConvNet,
+``/root/reference/main.py:20-45``); these rungs come from the driver's
+``BASELINE.json`` configs[1-2]. Architecture follows the standard torchvision
+topology (BasicBlock for 18, Bottleneck for 50) so throughput comparisons
+are apples-to-apples, but built TPU-native: NHWC activations, HWIO kernels,
+pure-functional forward with explicit BatchNorm state.
+
+``small_input=True`` selects the common CIFAR stem (3x3 stride-1 conv, no
+maxpool) instead of the ImageNet 7x7/2 + pool stem.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from distributed_compute_pytorch_tpu.models import layers as L
+
+
+def _conv(cin, cout, k, stride, param_dtype):
+    pad = (k - 1) // 2
+    return L.Conv2d(cin, cout, k, stride,
+                    padding=((pad, pad), (pad, pad)),
+                    use_bias=False, param_dtype=param_dtype)
+
+
+@dataclass(frozen=True)
+class _Block:
+    """BasicBlock (expansion 1) or Bottleneck (expansion 4)."""
+
+    cin: int
+    cmid: int
+    stride: int
+    bottleneck: bool
+    param_dtype: jnp.dtype
+
+    @property
+    def cout(self) -> int:
+        return self.cmid * (4 if self.bottleneck else 1)
+
+    @property
+    def has_proj(self) -> bool:
+        return self.stride != 1 or self.cin != self.cout
+
+    def init(self, key):
+        keys = iter(jax.random.split(key, 8))
+        pd = self.param_dtype
+        if self.bottleneck:
+            convs = [_conv(self.cin, self.cmid, 1, 1, pd),
+                     _conv(self.cmid, self.cmid, 3, self.stride, pd),
+                     _conv(self.cmid, self.cout, 1, 1, pd)]
+        else:
+            convs = [_conv(self.cin, self.cmid, 3, self.stride, pd),
+                     _conv(self.cmid, self.cout, 3, 1, pd)]
+        params, state = {}, {}
+        for i, conv in enumerate(convs):
+            bn = L.BatchNorm(conv.out_channels)
+            params[f"conv{i}"] = conv.init(next(keys))
+            params[f"bn{i}"] = bn.init(None)
+            state[f"bn{i}"] = bn.init_state()
+        if self.has_proj:
+            proj = _conv(self.cin, self.cout, 1, self.stride, pd)
+            bn = L.BatchNorm(self.cout)
+            params["proj"] = proj.init(next(keys))
+            params["proj_bn"] = bn.init(None)
+            state["proj_bn"] = bn.init_state()
+        return params, state
+
+    def apply(self, params, state, x, train: bool):
+        pd = self.param_dtype
+        if self.bottleneck:
+            convs = [_conv(self.cin, self.cmid, 1, 1, pd),
+                     _conv(self.cmid, self.cmid, 3, self.stride, pd),
+                     _conv(self.cmid, self.cout, 1, 1, pd)]
+        else:
+            convs = [_conv(self.cin, self.cmid, 3, self.stride, pd),
+                     _conv(self.cmid, self.cout, 3, 1, pd)]
+        new_state = {}
+        y = x
+        for i, conv in enumerate(convs):
+            y = conv.apply(params[f"conv{i}"], y)
+            bn = L.BatchNorm(conv.out_channels)
+            y, new_state[f"bn{i}"] = bn.apply(params[f"bn{i}"],
+                                              state[f"bn{i}"], y, train)
+            if i < len(convs) - 1:
+                y = jax.nn.relu(y)
+        if self.has_proj:
+            proj = _conv(self.cin, self.cout, 1, self.stride, pd)
+            sc = proj.apply(params["proj"], x)
+            bn = L.BatchNorm(self.cout)
+            sc, new_state["proj_bn"] = bn.apply(params["proj_bn"],
+                                                state["proj_bn"], sc, train)
+        else:
+            sc = x
+        return jax.nn.relu(y + sc), new_state
+
+
+@dataclass(frozen=True)
+class ResNet:
+    """Functional ResNet; construct via :meth:`build`."""
+
+    depths: tuple[int, ...]
+    bottleneck: bool
+    num_classes: int = 10
+    in_channels: int = 3
+    small_input: bool = True      # CIFAR stem by default (ladder rung 1)
+    width: int = 64
+    param_dtype: jnp.dtype = jnp.float32
+
+    @classmethod
+    def build(cls, name: str, **kw) -> "ResNet":
+        if name == "resnet18":
+            return cls(depths=(2, 2, 2, 2), bottleneck=False, **kw)
+        if name == "resnet50":
+            kw.setdefault("small_input", False)  # ImageNet rung
+            return cls(depths=(3, 4, 6, 3), bottleneck=True, **kw)
+        raise ValueError(f"unknown resnet variant {name!r}")
+
+    def _blocks(self) -> list[_Block]:
+        blocks = []
+        cin = self.width
+        for stage, depth in enumerate(self.depths):
+            cmid = self.width * (2 ** stage)
+            for i in range(depth):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                b = _Block(cin, cmid, stride, self.bottleneck, self.param_dtype)
+                blocks.append(b)
+                cin = b.cout
+        return blocks
+
+    def init(self, key):
+        blocks = self._blocks()
+        keys = jax.random.split(key, len(blocks) + 2)
+        stem_k = 3 if self.small_input else 7
+        stem_s = 1 if self.small_input else 2
+        stem = _conv(self.in_channels, self.width, stem_k, stem_s,
+                     self.param_dtype)
+        stem_bn = L.BatchNorm(self.width)
+        head = L.Dense(blocks[-1].cout, self.num_classes,
+                       param_dtype=self.param_dtype)
+        params = {"stem": stem.init(keys[0]), "stem_bn": stem_bn.init(None),
+                  "head": head.init(keys[1])}
+        state = {"stem_bn": stem_bn.init_state()}
+        for i, b in enumerate(blocks):
+            params[f"block{i}"], state[f"block{i}"] = b.init(keys[2 + i])
+        return params, state
+
+    def apply(self, params, state, x, *, train: bool = False, rng=None):
+        del rng  # no dropout in resnets
+        blocks = self._blocks()
+        stem_k = 3 if self.small_input else 7
+        stem_s = 1 if self.small_input else 2
+        stem = _conv(self.in_channels, self.width, stem_k, stem_s,
+                     self.param_dtype)
+        stem_bn = L.BatchNorm(self.width)
+        new_state = {}
+        y = stem.apply(params["stem"], x)
+        y, new_state["stem_bn"] = stem_bn.apply(params["stem_bn"],
+                                                state["stem_bn"], y, train)
+        y = jax.nn.relu(y)
+        if not self.small_input:
+            y = L.max_pool2d(y, 3, 2, padding=1)
+        for i, b in enumerate(blocks):
+            y, new_state[f"block{i}"] = b.apply(params[f"block{i}"],
+                                                state[f"block{i}"], y, train)
+        y = jnp.mean(y, axis=(1, 2))  # global average pool
+        head = L.Dense(blocks[-1].cout, self.num_classes,
+                       param_dtype=self.param_dtype)
+        logits = head.apply(params["head"], y)
+        return logits, new_state
+
+    def loss_fn(self, logits, targets):
+        return L.cross_entropy_with_logits(logits, targets, "mean")
+
+    def loss_sum(self, logits, targets):
+        return L.cross_entropy_with_logits(logits, targets, "sum")

@@ -1,0 +1,126 @@
+"""Shared transformer building blocks for the BERT/GPT-2 rungs.
+
+TPU-first layout decisions:
+- attention/MLP widths chosen by config stay multiples of 128 so XLA tiles
+  cleanly onto the MXU;
+- QKV are one fused projection (one big matmul beats three small ones);
+- tensor-parallel sharding is expressed as data layout in
+  ``partition_rules`` — column-parallel fused QKV and MLP-in shard their
+  *output* feature dim over ``tensor``; row-parallel attn-out and MLP-out
+  shard their *input* dim, so XLA's partitioner inserts exactly the two
+  all-reduces per block Megatron-LM prescribes;
+- sequence axis can additionally be sharded over ``seq`` (ring attention in
+  ``parallel/ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from distributed_compute_pytorch_tpu.models import layers as L
+from distributed_compute_pytorch_tpu.ops import attention as A
+
+
+@dataclass(frozen=True)
+class TransformerBlock:
+    """Pre/post-LN transformer block with fused-QKV MHA and GELU MLP."""
+
+    d_model: int
+    num_heads: int
+    d_ff: int
+    dropout_rate: float = 0.1
+    pre_ln: bool = True            # GPT-2 style; False = BERT (post-LN)
+    causal: bool = False
+    seq_axis: str = "seq"          # ring attention engages when the current
+                                   # mesh has this axis with size > 1
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        ks = jax.random.split(key, 6)
+        pd = self.param_dtype
+        d = self.d_model
+        return {
+            "ln1": L.LayerNorm(d).init(None),
+            "qkv": L.Dense(d, 3 * d, param_dtype=pd).init(ks[0]),
+            "attn_out": L.Dense(d, d, param_dtype=pd).init(ks[1]),
+            "ln2": L.LayerNorm(d).init(None),
+            "mlp_in": L.Dense(d, self.d_ff, param_dtype=pd).init(ks[2]),
+            "mlp_out": L.Dense(self.d_ff, d, param_dtype=pd).init(ks[3]),
+        }
+
+    def _attn(self, params, x, rng, train):
+        from distributed_compute_pytorch_tpu.core.mesh import current_mesh
+        from distributed_compute_pytorch_tpu.parallel.ring_attention import (
+            ring_attention)
+
+        d = self.d_model
+        qkv = L.Dense(d, 3 * d).apply(params["qkv"], x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = A.split_heads(q, self.num_heads)
+        k = A.split_heads(k, self.num_heads)
+        v = A.split_heads(v, self.num_heads)
+        mesh = current_mesh()
+        if (mesh is not None and self.seq_axis in mesh.axis_names
+                and mesh.shape[self.seq_axis] > 1):
+            # sequence-parallel path: K/V ring over the seq axis
+            o = ring_attention(q, k, v, mesh, self.seq_axis,
+                               causal=self.causal)
+        else:
+            o = A.dot_product_attention(q, k, v, causal=self.causal)
+        o = A.merge_heads(o)
+        o = L.Dense(d, d).apply(params["attn_out"], o)
+        return L.dropout(o, self.dropout_rate, rng, train)
+
+    def _mlp(self, params, x, rng, train):
+        h = L.Dense(self.d_model, self.d_ff).apply(params["mlp_in"], x)
+        h = jax.nn.gelu(h)
+        h = L.Dense(self.d_ff, self.d_model).apply(params["mlp_out"], h)
+        return L.dropout(h, self.dropout_rate, rng, train)
+
+    def apply(self, params, x, *, rng=None, train: bool = False):
+        r1 = r2 = None
+        if train and rng is not None:
+            r1, r2 = jax.random.split(rng)
+        ln1 = L.LayerNorm(self.d_model)
+        ln2 = L.LayerNorm(self.d_model)
+        if self.pre_ln:
+            x = x + self._attn(params, ln1.apply(params["ln1"], x), r1, train)
+            x = x + self._mlp(params, ln2.apply(params["ln2"], x), r2, train)
+        else:  # post-LN (BERT)
+            x = ln1.apply(params["ln1"],
+                          x + self._attn(params, x, r1, train))
+            x = ln2.apply(params["ln2"], x + self._mlp(params, x, r2, train))
+        return x
+
+
+# Megatron-style tensor-parallel layout for the block param names above;
+# models prepend their own prefixes. Combined with FSDP fallback by
+# ShardingRules(fallback=FSDP()).
+TP_RULES = (
+    # column-parallel: shard output features
+    (r"qkv/kernel$", ("fsdp", "tensor")),
+    (r"qkv/bias$", ("tensor",)),
+    (r"mlp_in/kernel$", ("fsdp", "tensor")),
+    (r"mlp_in/bias$", ("tensor",)),
+    # row-parallel: shard input features
+    (r"attn_out/kernel$", ("tensor", "fsdp")),
+    (r"mlp_out/kernel$", ("tensor", "fsdp")),
+    # embeddings: shard vocab over fsdp, features over tensor
+    (r"embedding$", ("fsdp", "tensor")),
+)
+
+
+def tp_partition_rules():
+    """As ``ShardingRules``-ready (regex, PartitionSpec) pairs."""
+    from jax.sharding import PartitionSpec as P
+    rules = []
+    for pattern, axes in TP_RULES:
+        if len(axes) == 1:
+            rules.append((pattern, P(axes[0] if isinstance(axes[0], str)
+                                     else axes[0])))
+        else:
+            rules.append((pattern, P(*axes)))
+    return tuple(rules)

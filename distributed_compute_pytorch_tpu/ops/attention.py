@@ -1,0 +1,61 @@
+"""Attention ops.
+
+The reference has no attention anywhere (its model is a 7-layer CNN,
+``/root/reference/main.py:20-45``); these ops serve the BERT/GPT-2 ladder
+rungs (``BASELINE.json`` configs[3-4]) and the framework's long-context
+support (ring attention over a ``seq`` mesh axis lives in
+``parallel/ring_attention.py``; a fused Pallas kernel in ``ops/pallas/``).
+
+This module is the portable XLA path: einsum-based multi-head attention that
+compiles to MXU matmuls and lets XLA fuse the softmax chain. Numerically
+stable (max-subtracted softmax in float32) regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False, bias=None,
+                          mask=None, scale: float | None = None):
+    """Multi-head scaled dot-product attention.
+
+    Args:
+      q, k, v: ``[batch, heads, seq, head_dim]``.
+      causal: apply a lower-triangular mask (decoder-only models).
+      bias: optional additive logits bias broadcastable to
+        ``[batch, heads, q_len, kv_len]``.
+      mask: optional boolean mask, True = attend, same broadcast rules.
+      scale: logit scale; default ``1/sqrt(head_dim)``.
+
+    Returns ``[batch, heads, seq, head_dim]`` in q's dtype.
+    """
+    *_, q_len, head_dim = q.shape
+    kv_len = k.shape[-2]
+    scale = (head_dim ** -0.5) if scale is None else scale
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+        causal_mask = row >= col - (kv_len - q_len)
+        logits = jnp.where(causal_mask, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def split_heads(x, num_heads: int):
+    """``[b, t, d]`` -> ``[b, h, t, d/h]``."""
+    b, t, d = x.shape
+    return x.reshape(b, t, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x):
+    """``[b, h, t, hd]`` -> ``[b, t, h*hd]``."""
+    b, h, t, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * hd)

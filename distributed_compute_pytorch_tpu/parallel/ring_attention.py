@@ -1,0 +1,119 @@
+"""Ring attention — sequence/context parallelism over a ``seq`` mesh axis.
+
+Long-context support the reference never had (its model has no attention at
+all, SURVEY.md §5.7); first-class here per the framework mandate. The design
+is the TPU-idiomatic ring schedule (Liu et al., Ring Attention with Blockwise
+Transformers): Q stays put, K/V blocks rotate around the ``seq`` axis via
+``lax.ppermute`` (neighbour exchange rides the ICI torus), and each step
+folds one K/V block into a running flash-attention-style online softmax
+(running max ``m``, normaliser ``l``, accumulator ``o``). Peak memory per
+chip is O(T/P) in sequence instead of O(T), and logits never materialise as
+a [T, T] tensor.
+
+Causal masking is chunk-aware: a device skips compute-masking only where
+needed — each rotation step knows which global K/V chunk it holds, so the
+mask is exact across chunk boundaries.
+
+The public entry nests ``shard_map`` inside the caller's jit, so it composes
+with the data/fsdp/tensor axes of the same mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30  # finite "minus infinity": keeps the online softmax NaN-free
+
+
+def _block_attend(q, kb, vb, o, m, l, q_pos, k_pos, scale, causal):
+    """Fold one K/V block into the running (o, m, l) online softmax."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        allowed = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(allowed, s, _NEG_INF)
+    row_max = jnp.max(s, axis=-1)                       # [b,h,q]
+    m_new = jnp.maximum(m, row_max)
+    corr = jnp.exp(m - m_new)                           # rescale old mass
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(allowed[None, None], p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, vb.astype(p.dtype))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
+                   causal: bool = False, scale: float | None = None):
+    """Sequence-parallel attention over ``mesh``'s ``axis``.
+
+    Args:
+      q, k, v: ``[batch, heads, seq, head_dim]`` global arrays whose ``seq``
+        dim is (or will be) sharded over ``axis``. batch may additionally be
+        sharded over the batch axes; heads over ``tensor``.
+    Returns the attention output with the same sharding as ``q``.
+    """
+    *_, seq_len, head_dim = q.shape
+    scale = (head_dim ** -0.5) if scale is None else scale
+    n_chunks = mesh.shape[axis]
+    if n_chunks == 1:
+        from distributed_compute_pytorch_tpu.ops.attention import (
+            dot_product_attention)
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    chunk = seq_len // n_chunks
+
+    # batch/head dims keep whatever sharding they already have; we only
+    # manage the seq dim explicitly. data/fsdp shard batch, tensor shards
+    # heads — all compose because shard_map specs name only mesh axes that
+    # exist.
+    names = mesh.axis_names
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in names) or None
+    head_axes = "tensor" if "tensor" in names else None
+    spec = P(batch_axes, head_axes, axis, None)
+
+    perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
+    vary = tuple(a for a in ((batch_axes or ()) + ((head_axes,)
+                 if head_axes else ()) + (axis,)))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec)
+    def _ring(q, k, v):
+        my_chunk = lax.axis_index(axis)
+        q_pos = my_chunk * chunk + jnp.arange(chunk)
+        b, h, t, d = q.shape
+        # carries must be typed as varying over every axis k/v vary over
+        o = lax.pcast(jnp.zeros((b, h, t, d), jnp.float32), vary,
+                      to="varying")
+        m = lax.pcast(jnp.full((b, h, t), _NEG_INF, jnp.float32), vary,
+                      to="varying")
+        l = lax.pcast(jnp.zeros((b, h, t), jnp.float32), vary,
+                      to="varying")
+
+        # local block first (no communication), then permute-then-attend for
+        # the remaining n-1 blocks — exactly n-1 neighbour exchanges total.
+        o, m, l = _block_attend(q, k, v, o, m, l, q_pos, q_pos, scale, causal)
+
+        def body(carry, step):
+            o, m, l, kb, vb = carry
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            # after `step` rotations we hold the block that started on
+            # device (my_chunk - step) mod P
+            src = (my_chunk - step) % n_chunks
+            k_pos = src * chunk + jnp.arange(chunk)
+            o, m, l = _block_attend(q, kb, vb, o, m, l, q_pos, k_pos,
+                                    scale, causal)
+            return (o, m, l, kb, vb), None
+
+        if n_chunks > 1:
+            (o, m, l, _, _), _ = lax.scan(body, (o, m, l, k, v),
+                                          jnp.arange(1, n_chunks))
+        return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+    return _ring(q, k, v)

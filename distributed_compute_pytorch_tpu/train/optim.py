@@ -50,6 +50,7 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
                     **kw) -> optax.GradientTransformation:
     """Registry for the model ladder: the reference stack for parity runs,
     AdamW+warmup-cosine for the transformer rungs."""
+    total = kw.pop("total_steps", steps_per_epoch * 10)
     if name == "adadelta":
         return adadelta_steplr(lr, gamma, steps_per_epoch, **kw)
     if name == "sgd":
@@ -58,7 +59,6 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
             optax.scale_by_schedule(lambda s: -steplr(lr, gamma, steps_per_epoch)(s)),
         )
     if name == "adamw":
-        total = kw.pop("total_steps", steps_per_epoch * 10)
         sched = optax.warmup_cosine_decay_schedule(
             init_value=0.0, peak_value=lr,
             warmup_steps=max(warmup_steps, 1),

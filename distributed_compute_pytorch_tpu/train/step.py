@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
+from distributed_compute_pytorch_tpu.core.mesh import batch_sharding, use_mesh
 from distributed_compute_pytorch_tpu.parallel.api import (
     DataParallel, tree_shardings)
 
@@ -61,14 +61,31 @@ class TrainState:
 
 
 def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
-                  strategy=None, donate: bool = True):
+                  strategy=None, donate: bool = True, compute_dtype=None):
     """Build ``(init_fn, train_step, eval_step)`` for ``model`` on ``mesh``.
 
     ``strategy`` decides parameter layout (default pure DP = replicated,
-    reference parity). The returned functions are jit-compiled with explicit
-    in/out shardings; train_step donates the state buffers.
+    reference parity). ``compute_dtype`` (e.g. ``jnp.bfloat16``) casts
+    floating-point inputs before the forward pass — the TPU fast path; params
+    stay in their own dtype and are cast inside the layers. The returned
+    functions are jit-compiled; train_step donates the state buffers.
     """
     strategy = strategy or DataParallel()
+
+    def _cast(x):
+        if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(compute_dtype)
+        return x
+
+    def _cast_params(params):
+        """Mixed precision: compute in ``compute_dtype`` while master params
+        (and optimizer state) stay in their own dtype — the cast is inside
+        the grad closure, so gradients flow back to the master dtype. This is
+        what makes ``compute_dtype=bfloat16`` effective for token models too,
+        whose int inputs pass ``_cast`` untouched."""
+        if compute_dtype is None:
+            return params
+        return jax.tree.map(_cast, params)
 
     def _state_shardings(state_shapes: TrainState) -> TrainState:
         repl = NamedSharding(mesh, P())
@@ -109,16 +126,28 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, x, y):
         """One optimization step == reference ``train`` body (``main.py:57-63``)."""
+        x = _cast(x)
         step_rng = jax.random.fold_in(state.rng, state.step)
 
-        def loss_fn(params):
-            out, new_mstate = model.apply(params, state.model_state, x,
-                                          train=True, rng=step_rng)
-            loss = model.loss_fn(out, y)
-            return loss, new_mstate
+        if hasattr(model, "train_loss"):
+            # models owning their objective end-to-end (e.g. BERT's MLM
+            # masking needs the step rng before the forward pass)
+            def loss_fn(params):
+                return model.train_loss(_cast_params(params),
+                                        state.model_state, x, y,
+                                        rng=step_rng)
+        else:
+            def loss_fn(params):
+                out, new_mstate = model.apply(_cast_params(params),
+                                              state.model_state, x,
+                                              train=True, rng=step_rng)
+                loss = model.loss_fn(out, y)
+                return loss, new_mstate
 
-        (loss, new_mstate), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        # trace-time mesh context: lets layers (ring attention) find the mesh
+        with use_mesh(mesh):
+            (loss, new_mstate), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -136,7 +165,11 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         Returns device-side sums; the cross-replica ``all_reduce(SUM)`` of
         ``main.py:90-91`` is implicit in producing unsharded outputs.
         """
-        out, _ = model.apply(state.params, state.model_state, x, train=False)
+        with use_mesh(mesh):
+            out, _ = model.apply(_cast_params(state.params),
+                                 state.model_state, _cast(x), train=False)
+        if hasattr(model, "eval_metrics"):
+            return model.eval_metrics(out, y)
         loss_sum = model.loss_sum(out, y) if hasattr(model, "loss_sum") else \
             model.loss_fn(out, y) * x.shape[0]
         pred = jnp.argmax(out, axis=-1)

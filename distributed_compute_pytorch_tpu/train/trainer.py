@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from distributed_compute_pytorch_tpu.core.config import Config
@@ -67,23 +68,31 @@ class Trainer:
                                       config.batch_size, shuffle=False,
                                       seed=config.seed)
 
-        self.model = model if model is not None else build_model(config.model)
+        self.model = model if model is not None else build_model(
+            config.model, **self._model_kwargs())
         axes = dict(self.mesh.shape)
         self.strategy = strategy if strategy is not None else (
             FSDP() if axes.get("fsdp", 1) > 1 else DataParallel())
 
         self.tx = build_optimizer(
-            "adadelta", config.lr, config.gamma,
-            steps_per_epoch=self.train_feed.steps_per_epoch)
+            config.optimizer, config.lr, config.gamma,
+            steps_per_epoch=self.train_feed.steps_per_epoch,
+            total_steps=self.train_feed.steps_per_epoch * config.epochs)
+        compute_dtype = (None if config.compute_dtype in (None, "float32")
+                         else jnp.dtype(config.compute_dtype))
         self.init_fn, self.train_step, self.eval_step = make_step_fns(
             self.model, self.tx, self.mesh, self.strategy,
-            donate=config.donate)
+            donate=config.donate, compute_dtype=compute_dtype)
 
         self.state = self.init_fn(jax.random.key(config.seed))
         self.start_epoch = 0
         if config.resume and os.path.exists(config.ckpt_path):
             manifest = checkpoint.load_manifest(config.ckpt_path)
-            self.state = checkpoint.restore(config.ckpt_path, self.state)
+            # restore each leaf straight into its strategy layout — the
+            # freshly-initialised state already carries the right shardings
+            shardings = jax.tree.map(lambda a: a.sharding, self.state)
+            self.state = checkpoint.restore(config.ckpt_path, self.state,
+                                            shardings=shardings)
             self.start_epoch = int(manifest["epoch"]) + 1
             log0(f"resumed from {config.ckpt_path} at epoch {self.start_epoch}")
 
@@ -93,6 +102,26 @@ class Trainer:
              f" | model: {config.model} | dataset: {self.train_data.name}")
 
     # ------------------------------------------------------------------
+
+    def _model_kwargs(self) -> dict:
+        """Dataset-derived model construction kwargs, so every (model,
+        dataset) pairing the CLI can express actually builds."""
+        cfg = self.config
+        kw: dict = {}
+        inputs = self.train_data.inputs
+        if cfg.model in ("convnet", "resnet18", "resnet50"):
+            kw["num_classes"] = self.train_data.num_classes
+            kw["in_channels"] = int(inputs.shape[-1])
+            if cfg.model == "convnet":
+                kw["image_size"] = tuple(int(s) for s in inputs.shape[1:3])
+        if cfg.model in ("bert", "gpt2"):
+            kw["preset"] = cfg.model_preset
+            if cfg.model_preset == "tiny" or cfg.dataset.startswith("synthetic"):
+                kw["vocab_size"] = max(self.train_data.num_classes, 4)
+                kw["max_seq_len"] = int(inputs.shape[1])
+        if cfg.param_dtype not in (None, "float32"):
+            kw["param_dtype"] = jnp.dtype(cfg.param_dtype)
+        return kw
 
     def train_epoch(self, epoch: int) -> float:
         """One epoch; returns mean wall-time-throughput (samples/s)."""

@@ -1,0 +1,144 @@
+"""Ladder-rung models (BASELINE.md configs 1-4): shape/learning sanity and
+parallel-layout equivalence on the faked 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.core.mesh import make_mesh, batch_sharding
+from distributed_compute_pytorch_tpu.data.datasets import synthetic_images, synthetic_lm
+from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+from distributed_compute_pytorch_tpu.models.bert import BertMLM, BertConfig
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.models.resnet import ResNet
+from distributed_compute_pytorch_tpu.parallel.api import (
+    DataParallel, FSDP, ShardingRules)
+from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+
+def test_resnet18_forward_and_learning(devices8):
+    mesh = make_mesh("data=8", devices=devices8)
+    model = ResNet.build("resnet18", num_classes=10, in_channels=3,
+                         small_input=True, width=16)  # slim for CPU test
+    data = synthetic_images(64, (32, 32, 3), 10, seed=5)
+    feed = DeviceFeeder(data, mesh, 64, shuffle=False)
+    tx = build_optimizer("sgd", lr=0.1, gamma=1.0, steps_per_epoch=10)
+    init_fn, train_step, eval_step = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(0))
+    (x, y), = list(feed.epoch(0))
+    logits, _ = model.apply(jax.device_get(state.params),
+                            jax.device_get(state.model_state),
+                            jnp.asarray(jax.device_get(x))[:4], train=False)
+    assert logits.shape == (4, 10)
+    first = None
+    for _ in range(15):
+        state, m = train_step(state, x, y)
+        if first is None:
+            first = float(m["loss"])
+    assert float(m["loss"]) < first, (first, float(m["loss"]))
+
+
+def test_resnet50_builds():
+    model = ResNet.build("resnet50", num_classes=100)
+    params, state = model.init(jax.random.key(0))
+    # bottleneck expansion: final stage outputs 2048 channels
+    assert params["head"]["kernel"].shape == (2048, 100)
+    logits, _ = model.apply(params, state,
+                            jnp.zeros((1, 64, 64, 3)), train=False)
+    assert logits.shape == (1, 100)
+
+
+def test_gpt2_causal_lm_learns(devices8):
+    mesh = make_mesh("data=8", devices=devices8)
+    model = GPT2(GPT2Config.tiny())
+    data = synthetic_lm(64, seq_len=32, vocab=256, seed=0)
+    feed = DeviceFeeder(data, mesh, 64, shuffle=False)
+    tx = build_optimizer("adamw", lr=3e-3, gamma=1.0, steps_per_epoch=10,
+                         warmup_steps=2, total_steps=40)
+    init_fn, train_step, eval_step = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(0))
+    (x, y), = list(feed.epoch(0))
+    first = None
+    for _ in range(30):
+        state, m = train_step(state, x, y)
+        if first is None:
+            first = float(m["loss"])
+    # markov data: causal model must beat uniform (ln 256 = 5.54)
+    assert float(m["loss"]) < first * 0.8, (first, float(m["loss"]))
+    em = eval_step(state, x, y)
+    assert int(em["count"]) == 64 * 31  # token-level counting
+
+
+def test_gpt2_causality():
+    """Future tokens must not influence past logits."""
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (1, 16), 0, 256)
+    toks2 = toks.at[:, 10:].set(0)  # perturb the future
+    l1, _ = model.apply(params, {}, toks, train=False)
+    l2, _ = model.apply(params, {}, toks2, train=False)
+    np.testing.assert_allclose(np.asarray(l1[:, :10]), np.asarray(l2[:, :10]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bert_mlm_learns(devices8):
+    mesh = make_mesh("data=8", devices=devices8)
+    model = BertMLM(BertConfig.tiny())
+    data = synthetic_lm(64, seq_len=32, vocab=256, seed=1)
+    feed = DeviceFeeder(data, mesh, 64, shuffle=False)
+    tx = build_optimizer("adamw", lr=5e-3, gamma=1.0, steps_per_epoch=10,
+                         warmup_steps=2, total_steps=100)
+    init_fn, train_step, eval_step = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(0))
+    (x, y), = list(feed.epoch(0))
+    first = None
+    for i in range(60):
+        state, m = train_step(state, x, y)
+        if first is None:
+            first = float(m["loss"])
+        elif i % 10 == 0:
+            float(m["loss"])  # keep the dispatch queue short on CPU
+    assert float(m["loss"]) < first * 0.85, (first, float(m["loss"]))
+
+
+@pytest.mark.parametrize("mesh_spec,strategy_kind", [
+    ("data=2,fsdp=4", "fsdp"),
+    ("data=2,tensor=4", "tp"),
+    ("data=2,fsdp=2,tensor=2", "tp+fsdp"),
+])
+def test_gpt2_parallel_layouts_match_dp(devices8, mesh_spec, strategy_kind):
+    """TP and FSDP layouts must be numerically transparent for GPT-2."""
+    data = synthetic_lm(32, seq_len=16, vocab=256, seed=2)
+
+    def run(spec, strategy):
+        mesh = make_mesh(spec, devices=devices8)
+        model = GPT2(GPT2Config.tiny())
+        feed = DeviceFeeder(data, mesh, 32, shuffle=False)
+        tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+        init_fn, train_step, _ = make_step_fns(model, tx, mesh, strategy)
+        state = init_fn(jax.random.key(0))
+        (x, y), = list(feed.epoch(0))
+        for _ in range(2):
+            state, m = train_step(state, x, y)
+        return jax.device_get(state.params), float(m["loss"])
+
+    model = GPT2(GPT2Config.tiny())
+    rules = ShardingRules(rules=model.partition_rules(),
+                          fallback=FSDP(min_size_to_shard=64))
+    p_ref, l_ref = run("data=8", DataParallel())
+    p_par, l_par = run(mesh_spec, rules)
+    np.testing.assert_allclose(l_ref, l_par, rtol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_par)):
+        np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-5)
+
+
+def test_registry_builds_all():
+    from distributed_compute_pytorch_tpu.models.registry import build_model
+    assert build_model("convnet").__class__.__name__ == "ConvNet"
+    assert build_model("resnet18").__class__.__name__ == "ResNet"
+    assert build_model("resnet50").__class__.__name__ == "ResNet"
+    assert build_model("bert", preset="tiny").config.num_layers == 2
+    assert build_model("gpt2", preset="tiny").config.d_model == 64
