@@ -47,16 +47,20 @@ def main():
         jax.random.randint(jax.random.key(2), (batch,), 0, 10, jnp.int32),
         shard_y)
 
-    # warmup (includes compile)
+    import numpy as np
+
+    # warmup (includes compile). NOTE: block_until_ready can ack early on
+    # relayed/remote device transports, so completion is forced by actually
+    # fetching a value that depends on the last step.
     for _ in range(10):
         state, metrics = train_step(state, x, y)
-    jax.block_until_ready(state.params)
+    float(metrics["loss"])
 
     iters = 200
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = train_step(state, x, y)
-    jax.block_until_ready(state.params)
+    np.asarray(metrics["loss"])   # device->host fetch = true completion
     dt = time.perf_counter() - t0
 
     sps_per_chip = batch * iters / dt / n_chips

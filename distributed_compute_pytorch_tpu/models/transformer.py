@@ -36,6 +36,7 @@ class TransformerBlock:
     causal: bool = False
     seq_axis: str = "seq"          # ring attention engages when the current
                                    # mesh has this axis with size > 1
+    attn_impl: str = "auto"        # 'auto' = Pallas flash kernel on TPU
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -69,7 +70,7 @@ class TransformerBlock:
             o = ring_attention(q, k, v, mesh, self.seq_axis,
                                causal=self.causal)
         else:
-            o = A.dot_product_attention(q, k, v, causal=self.causal)
+            o = A.attention(q, k, v, causal=self.causal, impl=self.attn_impl)
         o = A.merge_heads(o)
         o = L.Dense(d, d).apply(params["attn_out"], o)
         return L.dropout(o, self.dropout_rate, rng, train)

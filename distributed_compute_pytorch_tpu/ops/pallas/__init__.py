@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Kernels follow the playbook in the TPU Pallas guide: VMEM-resident blocks,
+MXU-aligned tiles (128), sequential grid with scratch accumulators, and
+interpret mode on CPU so the same kernels run in the test mesh.
+"""
+
+from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
+    flash_attention)
+
+__all__ = ["flash_attention"]

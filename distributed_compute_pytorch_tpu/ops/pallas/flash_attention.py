@@ -1,0 +1,307 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+Why a hand kernel when XLA fuses well: dense attention materialises the
+[T, T] logits tensor in HBM; on TPU the HBM round-trip dominates once T is
+a few thousand. The flash schedule streams K/V blocks through VMEM with an
+online softmax, so logits never leave VMEM and memory is O(T) — the standard
+FlashAttention recurrence mapped onto the Pallas TPU grid model:
+
+- grid = (batch*heads, q_blocks, kv_blocks), innermost kv axis sequential,
+  accumulators (o, m, l) in VMEM scratch persisting across kv steps
+  (`@pl.when(kv==0)` init / `@pl.when(kv==last)` write, guide §Grid);
+- MXU matmuls via jnp.dot with preferred_element_type=float32 (guide §Math);
+- causal runs skip fully-masked kv blocks with `@pl.when`, mask the diagonal
+  block with broadcasted_iota (guide: 2D iota);
+- backward is the two-kernel split (dQ; dK/dV) using the saved logsumexp
+  and the precomputed row term delta = rowsum(dO * O).
+
+Block sizes default to 128 (MXU tile). Sequence lengths must divide the
+block size; the public wrapper falls back to the XLA path otherwise.
+On CPU (tests) kernels run in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 128
+_NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
+                *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    last_k = pl.num_programs(2) - 1
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    run = (ki * block_k < (qi + 1) * block_q) if causal else (ki == ki)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)              # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)              # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)              # [Bk, D]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_s[:, :1]                           # [Bq, 1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, -1, keepdims=True)
+        acc[:] = acc[:] * corr + jnp.dot(p, v,
+                                         preferred_element_type=jnp.float32)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == last_k)
+    def _write():
+        l = l_s[:, :1]
+        o_ref[0] = (acc[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # lanes broadcast to 128 to satisfy the TPU (8, 128) tiling rule
+        lse_ref[0] = m_s[:] + jnp.log(jnp.maximum(l_s[:], 1e-30))
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    grid = (bh, t // block_q, tk // block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    last_k = pl.num_programs(2) - 1
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ki * block_k < (qi + 1) * block_q) if causal else (ki == ki)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                       # [Bq, 1]
+        delta = delta_ref[0][:, :1]                   # [Bq, 1]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc[:] = dq_acc[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == last_k)
+    def _write():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    last_q = pl.num_programs(2) - 1
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = ((qi + 1) * block_q > ki * block_k) if causal else (qi == qi)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                 # [Bq, Bk]
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == last_q)
+    def _write():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k):
+    q, k, v, o, lse = res
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)  # [bh, t]
+    # lane-broadcast (transient) to satisfy the (8, 128) tiling rule on
+    # kernel inputs; the residual itself is stored rank-2
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
+    delta = jnp.broadcast_to(delta[..., None], lse.shape)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, t // block_q, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_use_interpret(),
+    )(q, k, v, g.astype(q.dtype), lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, tk // block_k, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_use_interpret(),
+    )(q, k, v, g.astype(q.dtype), lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    # keep only one lane of the lane-broadcast lse as the residual: 128x
+    # less residual memory held until this layer's backward runs
+    return o, (q, k, v, o, lse[..., 0])
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: float | None = None,
+                    block_q: int = DEFAULT_BLOCK,
+                    block_k: int = DEFAULT_BLOCK):
+    """Fused attention: ``[b, h, t, d]`` in, same out. Differentiable.
+
+    Requires q/kv sequence lengths divisible by the block sizes; callers
+    (``ops.attention.attention``) fall back to the XLA path otherwise.
+    """
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    if t % block_q or tk % block_k:
+        raise ValueError(f"seq lengths ({t}, {tk}) must divide blocks "
+                         f"({block_q}, {block_k})")
+    if causal and t != tk:
+        # the kernels' causal mask is self-attention (top-left) aligned;
+        # the dense path uses bottom-right alignment for q_len != kv_len
+        raise ValueError("causal flash attention requires q_len == kv_len; "
+                         "use the dense path for causal cross-attention")
+    scale = (d ** -0.5) if scale is None else scale
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    o = _flash(qf, kf, vf, scale, causal, block_q, block_k)
+    return o.reshape(b, h, t, d)
