@@ -1,0 +1,29 @@
+"""CLI entry point — the role of reference ``main.py:137-150`` + ``cbasics.sh``.
+
+Installed as the ``dcp-train`` console script; ``train.py`` at the repo root
+is a thin wrapper for uninstalled use.
+
+Single-host:        dcp-train --batch_size 128 --lr 0.001 --epochs 20
+CPU dev run:        dcp-train --force-cpu --mesh data=2
+Multi-host (pod):   run once per host with DCP_COORDINATOR=host0:port
+                    DCP_NUM_PROCESSES=N DCP_PROCESS_ID=i (or the flags), e.g.
+                    under ``gcloud compute tpus tpu-vm ssh --worker=all``.
+
+No process spawning: where the reference forked one process per device
+(``main.py:150``), the SPMD design runs one process per host over the whole
+mesh.
+"""
+
+from distributed_compute_pytorch_tpu.core.config import Config
+from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+
+def main(argv=None):
+    config = Config.from_argv(argv)
+    trainer = Trainer(config)
+    result = trainer.fit()
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,7 @@ class Config:
 
     # --- data / checkpoint paths ---
     data_dir: str = "./data"       # reference uses './data/' (main.py:107)
+    require_real_data: bool = False  # error (not warn) if real data missing
     ckpt_path: str = "checkpoint.npz"  # reference writes 'mnist.pt' (main.py:133)
     resume: bool = False           # restore path the reference lacks (SURVEY §5.4)
 
@@ -112,6 +113,8 @@ class Config:
         p.add_argument("--log_every", type=int, default=cls.log_every)
         p.add_argument("--seed", type=int, default=cls.seed)
         p.add_argument("--data_dir", type=str, default=cls.data_dir)
+        p.add_argument("--require_real_data", action="store_true",
+                       help="fail instead of substituting synthetic data")
         p.add_argument("--ckpt_path", type=str, default=cls.ckpt_path)
         p.add_argument("--resume", action="store_true")
         p.add_argument("--coordinator", type=str, default=None,

@@ -3,9 +3,9 @@
 The reference pulls MNIST through ``torchvision.datasets.MNIST`` with
 ``ToTensor`` + ``Normalize(0.1307, 0.3081)`` transforms
 (``/root/reference/main.py:107-108``). Here the idx-ubyte files are decoded
-directly (plain numpy; a C++ fast path lives in ``native/``), normalisation is
-identical, and when no data is on disk a *deterministic synthetic* dataset
-with the same shapes/statistics is generated so that tests and benchmarks
+directly in plain numpy, normalisation is identical, and when no data is on
+disk a *deterministic synthetic* dataset with the same shapes/statistics is
+generated — loudly, see ``_warn_synthetic`` — so that tests and benchmarks
 never need network access (the reference instead download-races across ranks,
 SURVEY.md §A.8).
 
@@ -17,9 +17,21 @@ from __future__ import annotations
 import gzip
 import os
 import struct
+import warnings
 from dataclasses import dataclass
 
 import numpy as np
+
+
+def _warn_synthetic(name: str, data_dir: str) -> None:
+    """A run that claims '<name>' metrics must not silently train on blobs."""
+    warnings.warn(
+        f"{name}: real data not found under {data_dir!r}; substituting a "
+        f"DETERMINISTIC SYNTHETIC dataset. Reported metrics are NOT {name} "
+        f"metrics. Place the raw files under {data_dir!r}, or pass "
+        f"--require_real_data (synthetic_fallback=False) to make this an "
+        f"error.",
+        stacklevel=3)
 
 MNIST_MEAN, MNIST_STD = 0.1307, 0.3081          # main.py:108
 CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
@@ -101,6 +113,7 @@ def load_mnist(data_dir: str = "./data", split: str = "train",
         return ArrayDataset(images, labels, name=f"mnist-{split}")
     if not synthetic_fallback:
         raise FileNotFoundError(f"MNIST idx files not found under {data_dir}")
+    _warn_synthetic("mnist", data_dir)
     n = 60_000 if split == "train" else 10_000
     return synthetic_images(n, (28, 28, 1), 10, seed=0 if split == "train" else 1,
                             name=f"mnist-{split}-synthetic")
@@ -130,6 +143,7 @@ def load_cifar10(data_dir: str = "./data", split: str = "train",
         return ArrayDataset(x, np.asarray(ys, np.int32), name=f"cifar10-{split}")
     if not synthetic_fallback:
         raise FileNotFoundError(f"CIFAR-10 not found under {data_dir}")
+    _warn_synthetic("cifar10", data_dir)
     n = 50_000 if split == "train" else 10_000
     return synthetic_images(n, (32, 32, 3), 10, seed=2 if split == "train" else 3,
                             name=f"cifar10-{split}-synthetic")
@@ -180,12 +194,16 @@ def synthetic_lm(n: int, seq_len: int, vocab: int, seed: int = 0,
 
 
 def load_dataset(name: str, data_dir: str = "./data", split: str = "train",
-                 **kw) -> ArrayDataset:
-    """Registry entry point used by the trainer CLI."""
+                 synthetic_fallback: bool = True, **kw) -> ArrayDataset:
+    """Registry entry point used by the trainer CLI.
+
+    ``synthetic_fallback=False`` (CLI ``--require_real_data``) turns the
+    missing-real-data substitution into a hard error.
+    """
     if name == "mnist":
-        return load_mnist(data_dir, split)
+        return load_mnist(data_dir, split, synthetic_fallback)
     if name == "cifar10":
-        return load_cifar10(data_dir, split)
+        return load_cifar10(data_dir, split, synthetic_fallback)
     if name == "synthetic-images":
         return synthetic_images(kw.pop("n", 4096), kw.pop("shape", (28, 28, 1)),
                                 kw.pop("num_classes", 10),

@@ -30,12 +30,27 @@ def _local_row_span(sharding: NamedSharding, global_shape: tuple[int, ...]) -> s
     index map rather than assuming, so any mesh layout works.
     """
     index_map = sharding.addressable_devices_indices_map(global_shape)
-    starts, stops = [], []
+    spans = set()
     for idx in index_map.values():
         row = idx[0]
-        starts.append(row.start or 0)
-        stops.append(row.stop if row.stop is not None else global_shape[0])
-    return slice(min(starts), max(stops))
+        start = row.start or 0
+        stop = row.stop if row.stop is not None else global_shape[0]
+        spans.add((start, stop))
+    starts = sorted(s for s, _ in spans)
+    stops = sorted(e for _, e in spans)
+    lo, hi = starts[0], stops[-1]
+    # each device owns one row range; ranges must tile [lo, hi) contiguously
+    # (they do when batch axes lead the mesh axis order). A mesh spec that
+    # orders a non-batch axis first can hand this process non-contiguous
+    # rows, and silently slicing [lo, hi) would feed wrong data — refuse.
+    covered = sum(e - s for s, e in spans)
+    if covered != hi - lo or any(
+            a != b for a, b in zip(stops[:-1], starts[1:])):
+        raise ValueError(
+            "this process's devices own non-contiguous batch rows "
+            f"({sorted(spans)}); order the batch axes (data, fsdp) first in "
+            "the mesh spec so each host feeds one contiguous row range")
+    return slice(lo, hi)
 
 
 class DeviceFeeder:

@@ -62,7 +62,9 @@ class ShardedSampler:
         constructing the same seeded sampler, ``main.py:103,109``).
         """
         if self.shuffle:
-            rng = np.random.Generator(np.random.Philox(key=self.seed + epoch))
+            # 2-word key so (seed, epoch) pairs never collide — seed+epoch
+            # would make (0,1) and (1,0) replay the same permutation
+            rng = np.random.Generator(np.random.Philox(key=[self.seed, epoch]))
             order = rng.permutation(self.num_examples)
         else:
             order = np.arange(self.num_examples)

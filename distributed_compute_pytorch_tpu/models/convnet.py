@@ -71,7 +71,9 @@ class ConvNet:
         x = self.conv2.apply(params["conv2"], x)
         x = jax.nn.relu(x)
         x = L.max_pool2d(x, 2)
-        x = L.dropout(x, 0.25, r1, train)
+        # reference uses nn.Dropout2d(0.25) (main.py:25): channel-wise — the
+        # mask zeroes whole feature maps, broadcast over spatial dims
+        x = L.dropout(x, 0.25, r1, train, broadcast_dims=(1, 2))
         x = x.reshape(x.shape[0], -1)
         x = self.fc1.apply(params["fc1"], x)
         x, bn_state = self.bn.apply(params["batchnorm"], state["batchnorm"],

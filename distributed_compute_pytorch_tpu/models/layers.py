@@ -129,13 +129,21 @@ def avg_pool2d(x, window: int = 2, stride: int | None = None):
     return summed / (window * window)
 
 
-def dropout(x, rate: float, rng, train: bool):
+def dropout(x, rate: float, rng, train: bool,
+            broadcast_dims: Sequence[int] = ()):
     """``nn.Dropout`` equivalent (reference ``main.py:25-26``). Pure: identity
-    when not training or rate==0; otherwise inverted-scaling mask from ``rng``."""
+    when not training or rate==0; otherwise inverted-scaling mask from ``rng``.
+
+    ``broadcast_dims`` are axes the mask is shared across: ``nn.Dropout2d``
+    (reference ``main.py:25``) zeroes whole channels, i.e. in NHWC the mask
+    is drawn per ``[B, 1, 1, C]`` and broadcast over the spatial dims (1, 2).
+    """
     if not train or rate == 0.0:
         return x
     keep = 1.0 - rate
-    mask = jax.random.bernoulli(rng, keep, x.shape)
+    mask_shape = tuple(1 if d in tuple(broadcast_dims) else s
+                       for d, s in enumerate(x.shape))
+    mask = jax.random.bernoulli(rng, keep, mask_shape)
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
@@ -146,16 +154,19 @@ class BatchNorm:
     Normalises over all axes but the last; keeps running stats with torch's
     momentum convention (``new = (1-m)*old + m*batch``, m=0.1, eps=1e-5).
 
-    SPMD note (SURVEY §7 hard part b): stats are computed over the *local*
-    shard only — per-replica stats — which is exactly the reference's
-    behaviour (DDP syncs gradients, not BN buffers). A cross-replica ``pmean``
-    variant would be a behaviour change, so it's opt-in via ``axis_name``.
+    SPMD note (SURVEY §7 hard part b): ``jnp.mean``/``var`` here reduce over
+    the *global* batch dimension of the sharded array — under jit the SPMD
+    partitioner inserts the cross-device reduction, so this is **sync-BN**
+    (global-batch statistics) whenever the batch is sharded over mesh axes.
+    That is a deliberate deviation from the reference, whose DDP syncs
+    gradients but not BN stats (per-replica stats): global stats are what
+    make DP-N numerically equal to one big-device run, which our tests pin
+    (``tests/test_step.py``, ``tests/test_batchnorm.py``).
     """
 
     num_features: int
     momentum: float = 0.1
     eps: float = 1e-5
-    axis_name: str | tuple[str, ...] | None = None  # set to sync stats cross-replica
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -174,11 +185,6 @@ class BatchNorm:
         if train:
             mean = jnp.mean(x, reduce_axes)
             var = jnp.var(x, reduce_axes)
-            if self.axis_name is not None:
-                mean = lax.pmean(mean, self.axis_name)
-                # E[x^2] - E[x]^2 with pmean'd moments for a true global var
-                ex2 = lax.pmean(jnp.mean(jnp.square(x), reduce_axes), self.axis_name)
-                var = ex2 - jnp.square(mean)
             n = x.size // x.shape[-1]
             unbiased = var * (n / max(n - 1, 1))
             new_state = {

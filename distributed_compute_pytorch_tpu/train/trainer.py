@@ -28,7 +28,8 @@ from distributed_compute_pytorch_tpu.core.mesh import (
 from distributed_compute_pytorch_tpu.data.datasets import load_dataset
 from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
 from distributed_compute_pytorch_tpu.models.registry import build_model
-from distributed_compute_pytorch_tpu.parallel.api import DataParallel, FSDP
+from distributed_compute_pytorch_tpu.parallel.api import (
+    DataParallel, FSDP, ShardingRules)
 from distributed_compute_pytorch_tpu.train import checkpoint
 from distributed_compute_pytorch_tpu.train.optim import build_optimizer
 from distributed_compute_pytorch_tpu.train.step import make_step_fns
@@ -55,11 +56,14 @@ class Trainer:
             jax.config.update("jax_platforms", "cpu")
         self.mesh = make_mesh(config.mesh)
 
+        fallback_ok = not config.require_real_data
         self.train_data = train_data if train_data is not None else \
-            load_dataset(config.dataset, config.data_dir, "train")
+            load_dataset(config.dataset, config.data_dir, "train",
+                         synthetic_fallback=fallback_ok)
         self.eval_data = eval_data if eval_data is not None else \
             (self.train_data if config.eval_on_train
-             else load_dataset(config.dataset, config.data_dir, "test"))
+             else load_dataset(config.dataset, config.data_dir, "test",
+                               synthetic_fallback=fallback_ok))
 
         self.train_feed = DeviceFeeder(self.train_data, self.mesh,
                                        config.batch_size, shuffle=True,
@@ -70,9 +74,8 @@ class Trainer:
 
         self.model = model if model is not None else build_model(
             config.model, **self._model_kwargs())
-        axes = dict(self.mesh.shape)
-        self.strategy = strategy if strategy is not None else (
-            FSDP() if axes.get("fsdp", 1) > 1 else DataParallel())
+        self.strategy = (strategy if strategy is not None
+                         else self._pick_strategy())
 
         self.tx = build_optimizer(
             config.optimizer, config.lr, config.gamma,
@@ -102,6 +105,26 @@ class Trainer:
              f" | model: {config.model} | dataset: {self.train_data.name}")
 
     # ------------------------------------------------------------------
+
+    def _pick_strategy(self):
+        """Parameter-layout strategy from the mesh spec — the one-knob
+        parallelism the reference gets from ``--gpus`` (``main.py:144``):
+        ``--mesh`` alone decides DP / FSDP / TP and their compositions.
+
+        - ``fsdp`` axis > 1      -> FSDP parameter sharding
+        - ``tensor`` axis > 1    -> the model's Megatron-style
+          ``partition_rules()`` (stacked on the FSDP/DP fallback)
+        """
+        axes = dict(self.mesh.shape)
+        fallback = FSDP() if axes.get("fsdp", 1) > 1 else DataParallel()
+        if axes.get("tensor", 1) > 1:
+            if hasattr(self.model, "partition_rules"):
+                return ShardingRules(rules=self.model.partition_rules(),
+                                     fallback=fallback)
+            log0(f"WARNING: mesh has tensor={axes['tensor']} but model "
+                 f"{self.config.model!r} exposes no partition_rules(); the "
+                 f"tensor axis will only replicate")
+        return fallback
 
     def _model_kwargs(self) -> dict:
         """Dataset-derived model construction kwargs, so every (model,
@@ -135,7 +158,10 @@ class Trainer:
                 # (reference cadence, main.py:64)
                 self.logger.train_line(epoch, b, steps,
                                        float(metrics["loss"]))
-        jax.block_until_ready(self.state.params)
+        # fence via a device->host fetch of a value depending on the last
+        # step: block_until_ready can ack early on relayed TPU transports,
+        # which would overstate samples/s (bench.py uses the same fence)
+        np.asarray(metrics["loss"])
         secs = timer.elapsed()
         return steps * cfg.batch_size / secs
 

@@ -1,0 +1,86 @@
+"""Pin BatchNorm's SPMD semantics: global-batch (sync-BN) statistics.
+
+VERDICT r1 weak #5: the layer's docstring used to claim per-replica stats.
+The truth under jit-SPMD is that reducing a batch-sharded global array gives
+*global* statistics (XLA inserts the cross-device reduction). These tests pin
+that behaviour on a data=8 mesh so a future refactor can't silently change
+it, and verify the running-stats update matches torch's momentum convention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.core.mesh import batch_sharding, make_mesh
+from distributed_compute_pytorch_tpu.models import layers as L
+
+
+@pytest.fixture(scope="module")
+def mesh8(devices8):
+    return make_mesh("data=8", devices=devices8)
+
+
+def test_bn_stats_are_global_under_sharding(mesh8):
+    """Stats computed on a data=8-sharded batch == stats of the full batch
+    computed unsharded — sync-BN by construction."""
+    bn = L.BatchNorm(16)
+    params, state = bn.init(None), bn.init_state()
+    # deliberately non-iid across shards: shard i has mean ~ i
+    x = np.random.default_rng(0).normal(
+        size=(64, 16)).astype(np.float32)
+    x += np.repeat(np.arange(8), 8)[:, None].astype(np.float32)
+
+    x_sharded = jax.device_put(jnp.asarray(x), batch_sharding(mesh8, 2))
+
+    @jax.jit
+    def run(x):
+        return bn.apply(params, state, x, train=True)
+
+    y_sharded, st_sharded = run(x_sharded)
+    y_local, st_local = run(jnp.asarray(x))  # unsharded single-device truth
+
+    np.testing.assert_allclose(np.asarray(st_sharded["mean"]),
+                               np.asarray(st_local["mean"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(st_sharded["var"]),
+                               np.asarray(st_local["var"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y_sharded), np.asarray(y_local),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bn_running_stats_torch_momentum():
+    """new = (1-m)*old + m*batch with unbiased batch var, m=0.1 (torch)."""
+    torch = pytest.importorskip("torch")
+    bn = L.BatchNorm(8)
+    params, state = bn.init(None), bn.init_state()
+    x = np.random.default_rng(1).normal(size=(32, 8)).astype(np.float32)
+
+    tbn = torch.nn.BatchNorm1d(8, momentum=0.1, eps=1e-5)
+    tbn.train()
+    tx = torch.tensor(x)
+    ty = tbn(tx)
+
+    y, new_state = bn.apply(params, state, jnp.asarray(x), train=True)
+    np.testing.assert_allclose(np.asarray(new_state["mean"]),
+                               tbn.running_mean.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_state["var"]),
+                               tbn.running_var.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_channel_dropout_zeroes_whole_channels():
+    """Dropout2d semantics (reference main.py:25): the mask broadcasts over
+    spatial dims, so a dropped channel is zero everywhere in that example."""
+    x = jnp.ones((4, 6, 6, 32))
+    y = L.dropout(x, 0.5, jax.random.key(0), train=True,
+                  broadcast_dims=(1, 2))
+    y = np.asarray(y)
+    per_channel = y.reshape(4, 36, 32)
+    # every (example, channel) is either all-zero or all-scaled
+    all_zero = (per_channel == 0).all(axis=1)
+    all_kept = (per_channel == 2.0).all(axis=1)
+    assert np.all(all_zero | all_kept)
+    assert all_zero.any() and all_kept.any()

@@ -1,27 +1,8 @@
 #!/usr/bin/env python3
-"""CLI entry point — the role of reference ``main.py:137-150`` + ``cbasics.sh``.
+"""Repo-root launcher shim; the real CLI lives in
+``distributed_compute_pytorch_tpu.cli`` (installed as ``dcp-train``)."""
 
-Single-host:        python3 train.py --batch_size 128 --lr 0.001 --epochs 20
-CPU dev run:        JAX_PLATFORMS=cpu python3 train.py --force-cpu --mesh data=2
-Multi-host (pod):   run once per host with DCP_COORDINATOR=host0:port
-                    DCP_NUM_PROCESSES=N DCP_PROCESS_ID=i (or the flags), e.g.
-                    under `gcloud compute tpus tpu-vm ssh --worker=all`.
-
-No process spawning: where the reference forked one process per device
-(``main.py:150``), the SPMD design runs one process per host over the whole
-mesh.
-"""
-
-from distributed_compute_pytorch_tpu.core.config import Config
-from distributed_compute_pytorch_tpu.train.trainer import Trainer
-
-
-def main(argv=None):
-    config = Config.from_argv(argv)
-    trainer = Trainer(config)
-    result = trainer.fit()
-    return result
-
+from distributed_compute_pytorch_tpu.cli import main
 
 if __name__ == "__main__":
     main()
