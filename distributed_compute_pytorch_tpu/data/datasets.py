@@ -107,8 +107,12 @@ def load_mnist(data_dir: str = "./data", split: str = "train",
     img_path = _find_idx(data_dir, f"{prefix}-images-idx3-ubyte")
     lbl_path = _find_idx(data_dir, f"{prefix}-labels-idx1-ubyte")
     if img_path and lbl_path:
-        raw = _read_idx(img_path).astype(np.float32) / 255.0
-        images = ((raw - MNIST_MEAN) / MNIST_STD)[..., None]
+        raw = _read_idx(img_path)
+        from distributed_compute_pytorch_tpu import native
+        images = native.normalize_u8(raw, MNIST_MEAN, MNIST_STD)
+        if images is None:  # no compiler: numpy fallback, same math
+            images = (raw.astype(np.float32) / 255.0 - MNIST_MEAN) / MNIST_STD
+        images = images[..., None]
         labels = _read_idx(lbl_path).astype(np.int32)
         return ArrayDataset(images, labels, name=f"mnist-{split}")
     if not synthetic_fallback:
@@ -138,8 +142,12 @@ def load_cifar10(data_dir: str = "./data", split: str = "train",
                 d = pickle.load(f, encoding="bytes")
             xs.append(d[b"data"])
             ys.extend(d[b"labels"])
-        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-        x = (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
+        chw = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        from distributed_compute_pytorch_tpu import native
+        x = native.chw_to_hwc_normalize(chw, CIFAR_MEAN, CIFAR_STD)
+        if x is None:  # no compiler: numpy fallback, same math
+            x = chw.transpose(0, 2, 3, 1)
+            x = (x.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
         return ArrayDataset(x, np.asarray(ys, np.int32), name=f"cifar10-{split}")
     if not synthetic_fallback:
         raise FileNotFoundError(f"CIFAR-10 not found under {data_dir}")

@@ -106,8 +106,13 @@ class DeviceFeeder:
         tgt_shape = (self.global_batch, *self.dataset.targets.shape[1:])
         in_rows = _local_row_span(self.input_sharding, in_shape)
         tgt_rows = _local_row_span(self.target_sharding, tgt_shape)
+        from distributed_compute_pytorch_tpu import native
         for batch_idx in order:
-            x = self.dataset.inputs[batch_idx[in_rows]]
+            # row gather is the per-step host hot loop; the C++ path skips
+            # numpy fancy-indexing overhead (falls back transparently)
+            x = native.gather_rows(self.dataset.inputs, batch_idx[in_rows])
+            if x is None:
+                x = self.dataset.inputs[batch_idx[in_rows]]
             y = self.dataset.targets[batch_idx[tgt_rows]]
             yield (
                 jax.make_array_from_process_local_data(self.input_sharding, x, in_shape),

@@ -1,0 +1,133 @@
+"""ctypes bindings for the native data fast paths (``dcp_data.cc``).
+
+Build model: one ``g++ -O3 -shared`` invocation, cached next to the source
+(rebuilt when the source is newer). Import never fails — if no compiler is
+available the callers fall back to their numpy implementations, so the
+native layer is a pure accelerator, not a dependency.
+
+This replaces (TPU-side) the role of torchvision/Pillow's C decode path in
+the reference's data pipeline (``/root/reference/main.py:107-108``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import warnings
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "dcp_data.cc")
+_LIB_PATH = os.path.join(_DIR, "libdcp_data.so")
+
+_lib: ctypes.CDLL | None = None
+_failed = False   # sticky: one failed build/load disables the fast path
+
+
+def _build() -> bool:
+    # compile to a unique temp path then atomically rename: a killed g++ or
+    # two processes building concurrently (the multi-host tests do) must
+    # never leave a half-written .so that a later CDLL would choke on
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        warnings.warn(f"native build failed ({e}); using numpy fallbacks")
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _failed
+    if _lib is not None:
+        return _lib
+    if _failed:
+        return None
+    stale = (not os.path.exists(_LIB_PATH)
+             or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+    if stale and not _build():
+        _failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        warnings.warn(f"native library load failed ({e}); "
+                      f"using numpy fallbacks")
+        _failed = True
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.dcp_normalize_u8.argtypes = [u8p, f32p, ctypes.c_int64,
+                                     ctypes.c_float, ctypes.c_float]
+    lib.dcp_chw_to_hwc_normalize.argtypes = [u8p, f32p, ctypes.c_int64,
+                                             ctypes.c_int64, ctypes.c_int64,
+                                             f32p, f32p]
+    lib.dcp_gather_rows_f32.argtypes = [f32p, i64p, f32p,
+                                        ctypes.c_int64, ctypes.c_int64]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def normalize_u8(raw: np.ndarray, mean: float, std: float) -> np.ndarray | None:
+    """Fused ``(raw/255 - mean)/std`` for a uint8 array; None if the native
+    library is unavailable or the dtype isn't uint8 (caller falls back to
+    numpy — idx files may legally carry wider dtypes)."""
+    lib = _load()
+    if lib is None or raw.dtype != np.uint8:
+        return None
+    raw = np.ascontiguousarray(raw)
+    out = np.empty(raw.shape, np.float32)
+    lib.dcp_normalize_u8(_ptr(raw, ctypes.c_uint8), _ptr(out, ctypes.c_float),
+                         raw.size, ctypes.c_float(mean),
+                         ctypes.c_float(1.0 / std))
+    return out
+
+
+def chw_to_hwc_normalize(raw: np.ndarray, mean: np.ndarray,
+                         std: np.ndarray) -> np.ndarray | None:
+    """``[N, C, H, W] uint8`` -> normalised ``[N, H, W, C] float32``."""
+    lib = _load()
+    if lib is None or raw.dtype != np.uint8:
+        return None
+    n, c, h, w = raw.shape
+    raw = np.ascontiguousarray(raw)
+    mean = np.ascontiguousarray(mean, dtype=np.float32)
+    inv_std = np.ascontiguousarray(1.0 / np.asarray(std, np.float32))
+    out = np.empty((n, h, w, c), np.float32)
+    lib.dcp_chw_to_hwc_normalize(
+        _ptr(raw, ctypes.c_uint8), _ptr(out, ctypes.c_float),
+        n, c, h * w, _ptr(mean, ctypes.c_float), _ptr(inv_std, ctypes.c_float))
+    return out
+
+
+def gather_rows(arr: np.ndarray, idx: np.ndarray) -> np.ndarray | None:
+    """``arr[idx]`` for a C-contiguous float32 array, leading-axis gather."""
+    lib = _load()
+    if lib is None or arr.dtype != np.float32 or not arr.flags.c_contiguous:
+        return None
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    row_elems = int(np.prod(arr.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx), *arr.shape[1:]), np.float32)
+    lib.dcp_gather_rows_f32(_ptr(arr, ctypes.c_float),
+                            _ptr(idx, ctypes.c_int64),
+                            _ptr(out, ctypes.c_float), len(idx), row_elems)
+    return out

