@@ -8,11 +8,16 @@ imports jax.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8"
-)
+# DCP_TEST_TPU=1 keeps the real backend so the TPU-gated tests
+# (test_flash_tpu.py) run on hardware instead of skipping.
+_USE_TPU = os.environ.get("DCP_TEST_TPU") == "1"
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 # determinism + speed for CPU test runs
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
@@ -21,7 +26,8 @@ import jax  # noqa: E402
 # Environments that preload jax at interpreter startup (e.g. a TPU-plugin
 # sitecustomize) have already latched JAX_PLATFORMS from their own env; the
 # config update below wins as long as no backend has initialised yet.
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
