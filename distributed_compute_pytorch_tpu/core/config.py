@@ -46,8 +46,9 @@ class Config:
                                    # "data=2,fsdp=4", "data=1,tensor=4,seq=2"; -1 = infer
 
     # --- model / task selection (the reference has one model; we have a zoo) ---
-    model: str = "convnet"         # convnet | resnet18 | resnet50 | bert | gpt2
+    model: str = "convnet"         # convnet | resnet18 | resnet50 | bert | gpt2 | moe
     model_preset: str | None = None  # e.g. 'tiny' for test-scale transformers
+    microbatches: int | None = None  # GPipe microbatches under a pipe axis
     dataset: str = "mnist"         # mnist | cifar10 | synthetic-images | synthetic-lm
     optimizer: str = "adadelta"    # adadelta (reference stack) | sgd | adamw
 
@@ -58,6 +59,7 @@ class Config:
     # --- data / checkpoint paths ---
     data_dir: str = "./data"       # reference uses './data/' (main.py:107)
     require_real_data: bool = False  # error (not warn) if real data missing
+    download: bool = False         # fetch missing data (coordinator + barrier)
     ckpt_path: str = "checkpoint.npz"  # reference writes 'mnist.pt' (main.py:133)
     resume: bool = False           # restore path the reference lacks (SURVEY §5.4)
 
@@ -107,6 +109,9 @@ class Config:
         p.add_argument("--model", type=str, default=cls.model)
         p.add_argument("--model_preset", type=str, default=None,
                        help="e.g. 'tiny' for test-scale transformers")
+        p.add_argument("--microbatches", type=int, default=None,
+                       help="GPipe microbatch count under a pipe mesh axis "
+                            "(default: pipe size)")
         p.add_argument("--dataset", type=str, default=cls.dataset)
         p.add_argument("--optimizer", type=str, default=cls.optimizer,
                        help="adadelta (reference stack) | sgd | adamw")
@@ -115,6 +120,9 @@ class Config:
         p.add_argument("--data_dir", type=str, default=cls.data_dir)
         p.add_argument("--require_real_data", action="store_true",
                        help="fail instead of substituting synthetic data")
+        p.add_argument("--download", action="store_true",
+                       help="download missing dataset files (coordinator-"
+                            "only, like the reference's download=True)")
         p.add_argument("--ckpt_path", type=str, default=cls.ckpt_path)
         p.add_argument("--resume", action="store_true")
         p.add_argument("--coordinator", type=str, default=None,

@@ -79,6 +79,111 @@ def _read_idx(path: str) -> np.ndarray:
     return np.frombuffer(data, dtypes[dtype_code], offset=4 + 4 * ndim).reshape(shape)
 
 
+# --------------------------------------------------------------------------
+# dataset acquisition (the reference's datasets.MNIST(download=True) role,
+# main.py:107-108 — minus its all-ranks download race, SURVEY §A.8)
+# --------------------------------------------------------------------------
+
+MNIST_URLS = {
+    # classic mirrors; override with DCP_MNIST_BASE_URL (tests point this at
+    # a local fixture server — the framework never needs the network in CI)
+    "base": "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "files": ["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+              "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"],
+}
+
+
+def _barrier(name: str) -> None:
+    """Cross-process sync so non-coordinators wait for the download."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def download_mnist(data_dir: str = "./data", base_url: str | None = None,
+                   timeout: float = 60.0) -> bool:
+    """Fetch the MNIST idx files — coordinator-only, with a barrier.
+
+    The reference races every rank on ``datasets.MNIST(download=True)``
+    (``main.py:107,113``, SURVEY §A.8); here exactly one process writes
+    (atomic rename per file) and the rest block on the barrier then read.
+    Returns True if the files are present when done.
+    """
+    import urllib.request
+
+    from distributed_compute_pytorch_tpu.core.mesh import is_coordinator
+
+    base = base_url or os.environ.get("DCP_MNIST_BASE_URL",
+                                      MNIST_URLS["base"])
+    raw_dir = os.path.join(data_dir, "MNIST", "raw")
+    ok = True
+    if is_coordinator():
+        os.makedirs(raw_dir, exist_ok=True)
+        for fn in MNIST_URLS["files"]:
+            dst = os.path.join(raw_dir, fn)
+            if os.path.exists(dst) or os.path.exists(dst[:-3]):
+                continue
+            tmp = dst + ".part"
+            try:
+                with urllib.request.urlopen(base + fn, timeout=timeout) as r, \
+                        open(tmp, "wb") as f:
+                    f.write(r.read())
+                # validate before install (tmp lacks the .gz suffix cue)
+                with open(tmp, "rb") as f:
+                    payload = f.read()
+                data = gzip.decompress(payload) if fn.endswith(".gz") \
+                    else payload
+                if struct.unpack(">HBB", data[:4])[0] != 0:
+                    raise ValueError(f"{fn}: bad idx magic after download")
+                os.replace(tmp, dst)
+            except Exception as e:      # noqa: BLE001 — degrade loudly
+                warnings.warn(f"MNIST download failed for {fn}: {e}")
+                ok = False
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+    _barrier("dcp:mnist-download")
+    have = all(
+        _find_idx(data_dir, fn[:-3]) for fn in MNIST_URLS["files"])
+    return ok and have
+
+
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+
+
+def download_cifar10(data_dir: str = "./data", url: str | None = None,
+                     timeout: float = 120.0) -> bool:
+    """Fetch + extract the CIFAR-10 python batches — coordinator-only, with
+    a barrier (same discipline as :func:`download_mnist`)."""
+    import io
+    import tarfile
+    import urllib.request
+
+    from distributed_compute_pytorch_tpu.core.mesh import is_coordinator
+
+    url = url or os.environ.get("DCP_CIFAR10_URL", CIFAR10_URL)
+    target = os.path.join(data_dir, "cifar-10-batches-py")
+    ok = True
+    if is_coordinator() and not os.path.exists(
+            os.path.join(target, "data_batch_1")):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                payload = r.read()
+            with tarfile.open(fileobj=io.BytesIO(payload), mode="r:gz") as t:
+                # extract into data_dir; archive root is cifar-10-batches-py
+                t.extractall(data_dir, filter="data")
+            if not os.path.exists(os.path.join(target, "data_batch_1")):
+                raise FileNotFoundError(
+                    "archive did not contain cifar-10-batches-py")
+        except Exception as e:      # noqa: BLE001 — degrade loudly
+            warnings.warn(f"CIFAR-10 download failed: {e}")
+            ok = False
+    _barrier("dcp:cifar10-download")
+    return ok and os.path.exists(os.path.join(target, "data_batch_1"))
+
+
 def _find_idx(data_dir: str, stem: str) -> str | None:
     """Locate an idx file under data_dir, tolerating the common layouts
     (flat, MNIST/raw/, gzipped)."""
@@ -96,14 +201,23 @@ def _find_idx(data_dir: str, stem: str) -> str | None:
 
 
 def load_mnist(data_dir: str = "./data", split: str = "train",
-               synthetic_fallback: bool = True) -> ArrayDataset:
+               synthetic_fallback: bool = True,
+               download: bool = False) -> ArrayDataset:
     """MNIST with the reference's exact normalisation (``main.py:108``).
 
     Returns images ``[N, 28, 28, 1] float32`` normalised by
-    ``(x/255 - 0.1307) / 0.3081`` and labels ``[N] int32``. Falls back to
-    :func:`synthetic_images` (same shapes) when files are absent.
+    ``(x/255 - 0.1307) / 0.3081`` and labels ``[N] int32``. With
+    ``download=True`` missing files are fetched first (coordinator-only +
+    barrier — the reference's ``download=True`` without its §A.8 race);
+    otherwise falls back to :func:`synthetic_images` (same shapes) when
+    files are absent.
     """
     prefix = "train" if split == "train" else "t10k"
+    if download:
+        # unconditional: every process must reach download_mnist's barrier
+        # even if ITS disk already has files (per-host disks can disagree,
+        # and a conditional call would deadlock the others)
+        download_mnist(data_dir)
     img_path = _find_idx(data_dir, f"{prefix}-images-idx3-ubyte")
     lbl_path = _find_idx(data_dir, f"{prefix}-labels-idx1-ubyte")
     if img_path and lbl_path:
@@ -124,9 +238,12 @@ def load_mnist(data_dir: str = "./data", split: str = "train",
 
 
 def load_cifar10(data_dir: str = "./data", split: str = "train",
-                 synthetic_fallback: bool = True) -> ArrayDataset:
+                 synthetic_fallback: bool = True,
+                 download: bool = False) -> ArrayDataset:
     """CIFAR-10 from the python-pickle batches; synthetic fallback otherwise."""
     import pickle
+    if download:
+        download_cifar10(data_dir)   # unconditional: see load_mnist note
     base = None
     for cand in ("cifar-10-batches-py", "."):
         p = os.path.join(data_dir, cand)
@@ -208,10 +325,13 @@ def load_dataset(name: str, data_dir: str = "./data", split: str = "train",
     ``synthetic_fallback=False`` (CLI ``--require_real_data``) turns the
     missing-real-data substitution into a hard error.
     """
+    download = kw.pop("download", False)
     if name == "mnist":
-        return load_mnist(data_dir, split, synthetic_fallback)
+        return load_mnist(data_dir, split, synthetic_fallback,
+                          download=download)
     if name == "cifar10":
-        return load_cifar10(data_dir, split, synthetic_fallback)
+        return load_cifar10(data_dir, split, synthetic_fallback,
+                            download=download)
     if name == "synthetic-images":
         return synthetic_images(kw.pop("n", 4096), kw.pop("shape", (28, 28, 1)),
                                 kw.pop("num_classes", 10),

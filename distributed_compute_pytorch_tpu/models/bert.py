@@ -19,9 +19,12 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from distributed_compute_pytorch_tpu.core.mesh import current_mesh
 from distributed_compute_pytorch_tpu.models import layers as L
 from distributed_compute_pytorch_tpu.models.transformer import (
     TransformerBlock, tp_partition_rules)
+from distributed_compute_pytorch_tpu.parallel.pipeline import (
+    pipeline_blocks, scan_blocks, stacked_layers)
 
 
 @dataclass(frozen=True)
@@ -35,6 +38,8 @@ class BertConfig:
     dropout_rate: float = 0.1
     mask_rate: float = 0.15
     mask_token_id: int = 103       # [MASK] in the WordPiece vocab
+    # GPipe microbatch count under a pipe axis (None = pipe size)
+    pipeline_microbatches: int | None = None
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -64,7 +69,8 @@ class BertMLM:
             "wte": wte.init(ks[0]),
             "wpe": wpe.init(ks[1]),
             "emb_ln": L.LayerNorm(c.d_model).init(None),
-            "blocks": [block.init(ks[2 + i]) for i in range(c.num_layers)],
+            "blocks": stacked_layers(
+                [block.init(ks[2 + i]) for i in range(c.num_layers)]),
             "mlm_dense": L.Dense(c.d_model, c.d_model,
                                  param_dtype=c.param_dtype).init(ks[-1]),
             "mlm_ln": L.LayerNorm(c.d_model).init(None),
@@ -80,15 +86,20 @@ class BertMLM:
         x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"],
                                                          jnp.arange(T))
         x = L.LayerNorm(c.d_model).apply(params["emb_ln"], x)
+        layers_rng = None
         if train and rng is not None:
-            rngs = jax.random.split(rng, c.num_layers + 1)
-            x = L.dropout(x, c.dropout_rate, rngs[0], train)
-        else:
-            rngs = [None] * (c.num_layers + 1)
+            emb_rng, layers_rng = jax.random.split(rng)
+            x = L.dropout(x, c.dropout_rate, emb_rng, train)
         block = self._block()
-        for i in range(c.num_layers):
-            x = block.apply(params["blocks"][i], x, rng=rngs[i + 1],
-                            train=train)
+        mesh = current_mesh()
+        if (mesh is not None and "pipe" in mesh.axis_names
+                and mesh.shape["pipe"] > 1):
+            x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
+                                num_microbatches=c.pipeline_microbatches,
+                                rng=layers_rng, train=train)
+        else:
+            x = scan_blocks(block.apply, params["blocks"], x,
+                            rng=layers_rng, train=train)
         h = L.Dense(c.d_model, c.d_model).apply(params["mlm_dense"], x)
         h = jax.nn.gelu(h)
         h = L.LayerNorm(c.d_model).apply(params["mlm_ln"], h)

@@ -9,7 +9,10 @@ config knob so tests run tiny.
 
 Parallelism: ``partition_rules()`` provides the Megatron TP layout for the
 block weights (see ``models/transformer.py``); pair with the ``fsdp`` axis
-for FSDP and with ``seq`` + ``parallel/ring_attention`` for long context.
+for FSDP, ``seq`` + ``parallel/ring_attention`` for long context, and
+``pipe`` for pipeline parallelism — the blocks are *stacked* (leading
+``[num_layers]`` dim, scanned off-pipeline; GPipe schedule over ``pipe``
+when the mesh carries one — see ``parallel/pipeline.py``).
 """
 
 from __future__ import annotations
@@ -19,9 +22,12 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from distributed_compute_pytorch_tpu.core.mesh import current_mesh
 from distributed_compute_pytorch_tpu.models import layers as L
 from distributed_compute_pytorch_tpu.models.transformer import (
     TransformerBlock, tp_partition_rules)
+from distributed_compute_pytorch_tpu.parallel.pipeline import (
+    pipeline_blocks, scan_blocks, stacked_layers)
 
 
 @dataclass(frozen=True)
@@ -33,6 +39,9 @@ class GPT2Config:
     d_model: int = 768
     d_ff: int = 3072
     dropout_rate: float = 0.1
+    # GPipe microbatch count under a pipe axis (None = pipe size). Bubble
+    # fraction is (P-1)/(M+P-1): raise M to amortise.
+    pipeline_microbatches: int | None = None
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -67,7 +76,10 @@ class GPT2:
         params = {
             "wte": wte.init(ks[0]),
             "wpe": wpe.init(ks[1]),
-            "blocks": [block.init(ks[2 + i]) for i in range(c.num_layers)],
+            # stacked [num_layers, ...] leaves: scanned (or pipelined over
+            # the pipe axis) instead of python-looped
+            "blocks": stacked_layers(
+                [block.init(ks[2 + i]) for i in range(c.num_layers)]),
             "ln_f": L.LayerNorm(c.d_model).init(None),
         }
         return params, {}   # no batch-stat state in transformers
@@ -80,15 +92,20 @@ class GPT2:
         T = tokens.shape[1]
         pos = jnp.arange(T)
         x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"], pos)
+        layers_rng = None
         if train and rng is not None:
-            rngs = jax.random.split(rng, c.num_layers + 1)
-            x = L.dropout(x, c.dropout_rate, rngs[0], train)
-        else:
-            rngs = [None] * (c.num_layers + 1)
+            emb_rng, layers_rng = jax.random.split(rng)
+            x = L.dropout(x, c.dropout_rate, emb_rng, train)
         block = self._block()
-        for i in range(c.num_layers):
-            x = block.apply(params["blocks"][i], x, rng=rngs[i + 1],
-                            train=train)
+        mesh = current_mesh()
+        if (mesh is not None and "pipe" in mesh.axis_names
+                and mesh.shape["pipe"] > 1):
+            x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
+                                num_microbatches=c.pipeline_microbatches,
+                                rng=layers_rng, train=train)
+        else:
+            x = scan_blocks(block.apply, params["blocks"], x,
+                            rng=layers_rng, train=train)
         x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
         logits = wte.attend(params["wte"], x)  # weight-tied readout
         return logits, state

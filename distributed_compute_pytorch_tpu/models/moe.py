@@ -1,0 +1,276 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+Capability beyond the reference (whose only model is a dense CNN,
+``/root/reference/main.py:20-45``); makes the framework's declared
+``expert`` axis real. The design is the TPU-idiomatic GShard/Switch
+formulation rather than a gather/scatter one:
+
+- **Einsum dispatch**: top-1 (Switch) routing builds a one-hot dispatch
+  tensor ``[tokens, experts, capacity]``; dispatch and combine are plain
+  einsums, so the whole layer is static-shaped matmuls the MXU likes — no
+  sorting, no dynamic shapes, fully differentiable (through the combine
+  weights).
+- **Expert parallelism as sharding**: expert weights are stacked
+  ``[E, ...]`` and sharded over ``expert``; a ``sharding_constraint`` pins
+  the dispatched activations ``[E, C, d]`` to the same axis, and XLA's SPMD
+  partitioner inserts the all-to-alls the layout implies — the same
+  "layout, not message-passing" principle the framework uses for DP/FSDP/TP.
+- **Load balancing**: the standard Switch auxiliary loss
+  ``E * mean(fraction_tokens * fraction_probs)`` plus a router z-loss keep
+  routing from collapsing; both are returned for the model to fold into its
+  objective.
+
+Tokens overflowing an expert's capacity are dropped (their combine weight
+is zero — the residual path carries them), exactly as in Switch/GShard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distributed_compute_pytorch_tpu.core.mesh import current_mesh
+from distributed_compute_pytorch_tpu.models import layers as L
+
+
+def _constrain(x, spec: P):
+    """Pin ``x``'s sharding when a mesh context is active (no-op off-mesh)."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    cleaned = tuple(
+        a if (a in mesh.axis_names and mesh.shape[a] > 1) else None
+        for a in spec)
+    if all(a is None for a in cleaned):
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, P(*cleaned)))
+
+
+@dataclass(frozen=True)
+class MoELayer:
+    """Switch-style top-1 MoE MLP: router + E expert FFNs (d -> ff -> d)."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        kr, ki, ko = jax.random.split(key, 3)
+        E, d, f = self.num_experts, self.d_model, self.d_ff
+        s_in, s_out = d ** -0.5, f ** -0.5
+        return {
+            "router": {"kernel": s_in * jax.random.normal(
+                kr, (d, E), self.param_dtype)},
+            "w_in": s_in * jax.random.normal(ki, (E, d, f), self.param_dtype),
+            "b_in": jnp.zeros((E, f), self.param_dtype),
+            "w_out": s_out * jax.random.normal(ko, (E, f, d), self.param_dtype),
+            "b_out": jnp.zeros((E, d), self.param_dtype),
+        }
+
+    def capacity(self, num_tokens: int) -> int:
+        c = int(self.capacity_factor * num_tokens / self.num_experts)
+        return max(c, 1)
+
+    def apply(self, params, x):
+        """``x [B, T, d]`` -> ``(y [B, T, d], aux)`` where ``aux`` carries
+        the load-balancing and router-z losses (fold into the objective as
+        ``loss + lb_weight*aux['lb_loss'] + z_weight*aux['z_loss']``)."""
+        B, T, d = x.shape
+        E = self.num_experts
+        N = B * T
+        C = self.capacity(N)
+        xf = x.reshape(N, d)
+
+        logits = (xf @ params["router"]["kernel"].astype(x.dtype)
+                  ).astype(jnp.float32)                        # [N, E]
+        probs = jax.nn.softmax(logits, -1)
+        gate = jnp.max(probs, -1)                              # [N]
+        expert_idx = jnp.argmax(probs, -1)                     # [N]
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+
+        # position of each token within its expert's queue (0-based);
+        # tokens past capacity are dropped (combine weight 0)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot     # [N, E]
+        keep = (pos < C) * onehot                              # [N, E]
+        pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
+                                dtype=jnp.float32)                 # [N, C]
+        dispatch = keep[:, :, None] * pos_oh[:, None, :]       # [N, E, C]
+
+        # ---- expert compute, sharded over the expert axis ----
+        ein = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xf)
+        ein = _constrain(ein, P("expert", None, None))
+        h = jnp.einsum("ecd,edf->ecf", ein,
+                       params["w_in"].astype(x.dtype))
+        h = jax.nn.gelu(h + params["b_in"].astype(x.dtype)[:, None, :])
+        out = jnp.einsum("ecf,efd->ecd", h,
+                         params["w_out"].astype(x.dtype))
+        out = out + params["b_out"].astype(x.dtype)[:, None, :]
+        out = _constrain(out, P("expert", None, None))
+
+        # dispatch already zeroes dropped tokens; weight kept ones by gate
+        combine = (dispatch * gate[:, None, None]).astype(x.dtype)
+        y = jnp.einsum("nec,ecd->nd", combine, out)
+
+        # Switch aux losses (float32 for stability)
+        frac_tokens = onehot.mean(0)                           # [E]
+        frac_probs = probs.mean(0)                             # [E]
+        lb_loss = E * jnp.sum(frac_tokens * frac_probs)
+        z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, -1)))
+        dropped = 1.0 - keep.sum() / N
+        aux = {"lb_loss": lb_loss, "z_loss": z_loss,
+               "dropped_fraction": dropped}
+        return y.reshape(B, T, d), aux
+
+
+@dataclass(frozen=True)
+class MoETransformerConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    lb_weight: float = 0.01
+    z_weight: float = 1e-3
+    dropout_rate: float = 0.0
+    param_dtype: jnp.dtype = jnp.float32
+
+    @classmethod
+    def tiny(cls) -> "MoETransformerConfig":
+        return cls(vocab_size=256, max_seq_len=64, num_layers=2, num_heads=4,
+                   d_model=64, d_ff=128, num_experts=4)
+
+
+@dataclass(frozen=True)
+class MoETransformerLM:
+    """Decoder-only LM whose every block uses a Switch-MoE MLP.
+
+    Same skeleton as GPT-2 (pre-LN, fused-QKV causal attention, tied
+    readout) with the dense MLP swapped for :class:`MoELayer`; blocks are
+    stacked and scanned with the aux losses accumulated through the scan
+    carry. ``pipe`` is not supported for MoE yet (aux plumbing); compose
+    with data/fsdp/tensor/expert axes.
+    """
+
+    config: MoETransformerConfig = MoETransformerConfig()
+
+    def _moe(self) -> MoELayer:
+        c = self.config
+        return MoELayer(c.d_model, c.d_ff, c.num_experts, c.capacity_factor,
+                        c.param_dtype)
+
+    def _block_init(self, key):
+        c = self.config
+        ks = jax.random.split(key, 4)
+        pd = c.param_dtype
+        d = c.d_model
+        return {
+            "ln1": L.LayerNorm(d).init(None),
+            "qkv": L.Dense(d, 3 * d, param_dtype=pd).init(ks[0]),
+            "attn_out": L.Dense(d, d, param_dtype=pd).init(ks[1]),
+            "ln2": L.LayerNorm(d).init(None),
+            "moe": self._moe().init(ks[2]),
+        }
+
+    def _block_apply(self, p, x, rng, train):
+        from distributed_compute_pytorch_tpu.models.transformer import (
+            attention_sublayer)
+        c = self.config
+        d = c.d_model
+        h = L.LayerNorm(d).apply(p["ln1"], x)
+        # shared attention half (flash kernel on TPU, ring attention on a
+        # seq>1 mesh — same dispatch as the dense blocks)
+        a = attention_sublayer(p, h, num_heads=c.num_heads, causal=True,
+                               dropout_rate=c.dropout_rate, rng=rng,
+                               train=train)
+        x = x + a
+        h = L.LayerNorm(d).apply(p["ln2"], x)
+        y, aux = self._moe().apply(p["moe"], h)
+        return x + y, aux
+
+    def init(self, key):
+        c = self.config
+        from distributed_compute_pytorch_tpu.parallel.pipeline import (
+            stacked_layers)
+        ks = jax.random.split(key, c.num_layers + 2)
+        wte = L.Embedding(c.vocab_size, c.d_model, param_dtype=c.param_dtype)
+        wpe = L.Embedding(c.max_seq_len, c.d_model,
+                          param_dtype=c.param_dtype, init_std=0.01)
+        params = {
+            "wte": wte.init(ks[0]),
+            "wpe": wpe.init(ks[1]),
+            "blocks": stacked_layers(
+                [self._block_init(ks[2 + i]) for i in range(c.num_layers)]),
+            "ln_f": L.LayerNorm(c.d_model).init(None),
+        }
+        return params, {}
+
+    def apply(self, params, state, tokens, *, train: bool = False, rng=None):
+        c = self.config
+        wte = L.Embedding(c.vocab_size, c.d_model)
+        wpe = L.Embedding(c.max_seq_len, c.d_model)
+        T = tokens.shape[1]
+        x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"],
+                                                         jnp.arange(T))
+        L_n = c.num_layers
+
+        def body(carry, scanned):
+            h, lb, z = carry
+            i, p = scanned
+            r = (jax.random.fold_in(rng, i)
+                 if (rng is not None and train) else None)
+            h, aux = self._block_apply(p, h, r, train)
+            return (h, lb + aux["lb_loss"], z + aux["z_loss"]), None
+
+        (x, lb, z), _ = jax.lax.scan(
+            body, (x, jnp.float32(0), jnp.float32(0)),
+            (jnp.arange(L_n), params["blocks"]))
+        x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
+        logits = wte.attend(params["wte"], x)
+        self_aux = {"lb_loss": lb / L_n, "z_loss": z / L_n}
+        return (logits, self_aux), state
+
+    # --- step.py train protocol (owns its objective: aux losses) ---
+
+    def train_loss(self, params, model_state, tokens, targets, rng,
+                   train: bool = True):
+        del targets
+        (logits, aux), new_state = self.apply(params, model_state, tokens,
+                                              train=train, rng=rng)
+        c = self.config
+        ce = L.cross_entropy_with_logits(logits[:, :-1], tokens[:, 1:],
+                                         "mean")
+        loss = ce + c.lb_weight * aux["lb_loss"] + c.z_weight * aux["z_loss"]
+        return loss, new_state
+
+    def eval_metrics(self, out, tokens):
+        logits, _ = out
+        pred = jnp.argmax(logits[:, :-1], axis=-1)
+        tgt = tokens[:, 1:]
+        return {
+            "loss_sum": L.cross_entropy_with_logits(
+                logits[:, :-1], tgt, "sum").astype(jnp.float32),
+            "correct": jnp.sum((pred == tgt).astype(jnp.int32)),
+            "count": jnp.asarray(tgt.size, jnp.int32),
+        }
+
+    def partition_rules(self):
+        """Expert weights: layer dim (stacked) + expert dim over ``expert``;
+        attention kernels follow the Megatron TP layout."""
+        return (
+            (r"blocks/moe/(w_in|w_out|b_in|b_out)$", P("pipe", "expert")),
+            (r"blocks/moe/router/kernel$", P("pipe")),
+            (r"blocks/qkv/kernel$", P("pipe", "fsdp", "tensor")),
+            (r"blocks/qkv/bias$", P("pipe", "tensor")),
+            (r"blocks/attn_out/kernel$", P("pipe", "tensor", "fsdp")),
+            (r"blocks/", P("pipe")),
+            (r"embedding$", P("fsdp", "tensor")),
+        )

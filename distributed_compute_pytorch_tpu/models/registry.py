@@ -37,4 +37,9 @@ def build_model(name: str, **kw: Any):
     if name == "gpt2":
         from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
         return GPT2(_transformer_config(GPT2Config, GPT2Config.small(), kw))
+    if name == "moe":
+        from distributed_compute_pytorch_tpu.models.moe import (
+            MoETransformerConfig, MoETransformerLM)
+        return MoETransformerLM(_transformer_config(
+            MoETransformerConfig, MoETransformerConfig(), kw))
     raise ValueError(f"unknown model {name!r}")

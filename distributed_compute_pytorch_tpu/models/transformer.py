@@ -24,6 +24,41 @@ from distributed_compute_pytorch_tpu.models import layers as L
 from distributed_compute_pytorch_tpu.ops import attention as A
 
 
+def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
+                       seq_axis: str = "seq", attn_impl: str = "auto",
+                       dropout_rate: float = 0.0, rng=None,
+                       train: bool = False):
+    """Fused-QKV multi-head attention + output projection + dropout.
+
+    The shared attention half of every transformer variant (dense blocks
+    here, MoE blocks in ``models/moe.py``), so all of them get the same
+    dispatch: the Pallas flash kernel on TPU for eligible shapes, and ring
+    attention when the current mesh carries a ``seq`` axis > 1.
+
+    ``params``: ``{"qkv": Dense(d, 3d), "attn_out": Dense(d, d)}`` trees.
+    """
+    from distributed_compute_pytorch_tpu.core.mesh import current_mesh
+    from distributed_compute_pytorch_tpu.parallel.ring_attention import (
+        ring_attention)
+
+    d = x.shape[-1]
+    qkv = L.Dense(d, 3 * d).apply(params["qkv"], x)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = A.split_heads(q, num_heads)
+    k = A.split_heads(k, num_heads)
+    v = A.split_heads(v, num_heads)
+    mesh = current_mesh()
+    if (mesh is not None and seq_axis in mesh.axis_names
+            and mesh.shape[seq_axis] > 1):
+        # sequence-parallel path: K/V ring over the seq axis
+        o = ring_attention(q, k, v, mesh, seq_axis, causal=causal)
+    else:
+        o = A.attention(q, k, v, causal=causal, impl=attn_impl)
+    o = A.merge_heads(o)
+    o = L.Dense(d, d).apply(params["attn_out"], o)
+    return L.dropout(o, dropout_rate, rng, train)
+
+
 @dataclass(frozen=True)
 class TransformerBlock:
     """Pre/post-LN transformer block with fused-QKV MHA and GELU MLP."""
@@ -53,27 +88,10 @@ class TransformerBlock:
         }
 
     def _attn(self, params, x, rng, train):
-        from distributed_compute_pytorch_tpu.core.mesh import current_mesh
-        from distributed_compute_pytorch_tpu.parallel.ring_attention import (
-            ring_attention)
-
-        d = self.d_model
-        qkv = L.Dense(d, 3 * d).apply(params["qkv"], x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = A.split_heads(q, self.num_heads)
-        k = A.split_heads(k, self.num_heads)
-        v = A.split_heads(v, self.num_heads)
-        mesh = current_mesh()
-        if (mesh is not None and self.seq_axis in mesh.axis_names
-                and mesh.shape[self.seq_axis] > 1):
-            # sequence-parallel path: K/V ring over the seq axis
-            o = ring_attention(q, k, v, mesh, self.seq_axis,
-                               causal=self.causal)
-        else:
-            o = A.attention(q, k, v, causal=self.causal, impl=self.attn_impl)
-        o = A.merge_heads(o)
-        o = L.Dense(d, d).apply(params["attn_out"], o)
-        return L.dropout(o, self.dropout_rate, rng, train)
+        return attention_sublayer(
+            params, x, num_heads=self.num_heads, causal=self.causal,
+            seq_axis=self.seq_axis, attn_impl=self.attn_impl,
+            dropout_rate=self.dropout_rate, rng=rng, train=train)
 
     def _mlp(self, params, x, rng, train):
         h = L.Dense(self.d_model, self.d_ff).apply(params["mlp_in"], x)
@@ -97,19 +115,26 @@ class TransformerBlock:
         return x
 
 
-# Megatron-style tensor-parallel layout for the block param names above;
-# models prepend their own prefixes. Combined with FSDP fallback by
-# ShardingRules(fallback=FSDP()).
+# Megatron-style tensor-parallel layout for the block param names above.
+# Blocks are STACKED (leading [num_layers] dim, see parallel/pipeline.py),
+# so every block rule leads with the ``pipe`` axis: under pipeline
+# parallelism each stage holds only its layers; on pipe-less meshes
+# ShardingRules drops the absent axis. Combined with FSDP fallback by
+# ShardingRules(fallback=FSDP()). Order matters: first match wins, the
+# ``blocks/`` catch-all (ln scales/biases — layer dim over pipe only) must
+# come after the specific kernels.
 TP_RULES = (
     # column-parallel: shard output features
-    (r"qkv/kernel$", ("fsdp", "tensor")),
-    (r"qkv/bias$", ("tensor",)),
-    (r"mlp_in/kernel$", ("fsdp", "tensor")),
-    (r"mlp_in/bias$", ("tensor",)),
+    (r"blocks/qkv/kernel$", ("pipe", "fsdp", "tensor")),
+    (r"blocks/qkv/bias$", ("pipe", "tensor")),
+    (r"blocks/mlp_in/kernel$", ("pipe", "fsdp", "tensor")),
+    (r"blocks/mlp_in/bias$", ("pipe", "tensor")),
     # row-parallel: shard input features
-    (r"attn_out/kernel$", ("tensor", "fsdp")),
-    (r"mlp_out/kernel$", ("tensor", "fsdp")),
-    # embeddings: shard vocab over fsdp, features over tensor
+    (r"blocks/attn_out/kernel$", ("pipe", "tensor", "fsdp")),
+    (r"blocks/mlp_out/kernel$", ("pipe", "tensor", "fsdp")),
+    # remaining stacked leaves (ln/bias): layer dim over pipe
+    (r"blocks/", ("pipe",)),
+    # embeddings (not stacked): shard vocab over fsdp, features over tensor
     (r"embedding$", ("fsdp", "tensor")),
 )
 
@@ -117,11 +142,4 @@ TP_RULES = (
 def tp_partition_rules():
     """As ``ShardingRules``-ready (regex, PartitionSpec) pairs."""
     from jax.sharding import PartitionSpec as P
-    rules = []
-    for pattern, axes in TP_RULES:
-        if len(axes) == 1:
-            rules.append((pattern, P(axes[0] if isinstance(axes[0], str)
-                                     else axes[0])))
-        else:
-            rules.append((pattern, P(*axes)))
-    return tuple(rules)
+    return tuple((pattern, P(*axes)) for pattern, axes in TP_RULES)

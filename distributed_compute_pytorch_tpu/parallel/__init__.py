@@ -11,5 +11,11 @@ from distributed_compute_pytorch_tpu.parallel.api import (
     ShardingRules,
     shard_pytree,
 )
+from distributed_compute_pytorch_tpu.parallel.pipeline import (
+    pipeline_blocks,
+    scan_blocks,
+    stacked_layers,
+)
 
-__all__ = ["DataParallel", "FSDP", "ShardingRules", "shard_pytree"]
+__all__ = ["DataParallel", "FSDP", "ShardingRules", "shard_pytree",
+           "pipeline_blocks", "scan_blocks", "stacked_layers"]

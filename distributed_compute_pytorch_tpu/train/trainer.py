@@ -59,11 +59,13 @@ class Trainer:
         fallback_ok = not config.require_real_data
         self.train_data = train_data if train_data is not None else \
             load_dataset(config.dataset, config.data_dir, "train",
-                         synthetic_fallback=fallback_ok)
+                         synthetic_fallback=fallback_ok,
+                         download=config.download)
         self.eval_data = eval_data if eval_data is not None else \
             (self.train_data if config.eval_on_train
              else load_dataset(config.dataset, config.data_dir, "test",
-                               synthetic_fallback=fallback_ok))
+                               synthetic_fallback=fallback_ok,
+                               download=config.download))
 
         self.train_feed = DeviceFeeder(self.train_data, self.mesh,
                                        config.batch_size, shuffle=True,
@@ -111,19 +113,22 @@ class Trainer:
         parallelism the reference gets from ``--gpus`` (``main.py:144``):
         ``--mesh`` alone decides DP / FSDP / TP and their compositions.
 
-        - ``fsdp`` axis > 1      -> FSDP parameter sharding
-        - ``tensor`` axis > 1    -> the model's Megatron-style
-          ``partition_rules()`` (stacked on the FSDP/DP fallback)
+        - ``fsdp`` axis > 1         -> FSDP parameter sharding
+        - ``tensor``/``pipe`` > 1   -> the model's ``partition_rules()``
+          (Megatron TP layout + stacked-layer dim over pipe), stacked on
+          the FSDP/DP fallback
         """
         axes = dict(self.mesh.shape)
         fallback = FSDP() if axes.get("fsdp", 1) > 1 else DataParallel()
-        if axes.get("tensor", 1) > 1:
+        model_axes = {a: n for a in ("tensor", "pipe", "expert")
+                      if (n := axes.get(a, 1)) > 1}
+        if model_axes:
             if hasattr(self.model, "partition_rules"):
                 return ShardingRules(rules=self.model.partition_rules(),
                                      fallback=fallback)
-            log0(f"WARNING: mesh has tensor={axes['tensor']} but model "
-                 f"{self.config.model!r} exposes no partition_rules(); the "
-                 f"tensor axis will only replicate")
+            log0(f"WARNING: mesh has {model_axes} but model "
+                 f"{self.config.model!r} exposes no partition_rules(); "
+                 f"these axes will only replicate")
         return fallback
 
     def _model_kwargs(self) -> dict:
@@ -137,11 +142,13 @@ class Trainer:
             kw["in_channels"] = int(inputs.shape[-1])
             if cfg.model == "convnet":
                 kw["image_size"] = tuple(int(s) for s in inputs.shape[1:3])
-        if cfg.model in ("bert", "gpt2"):
+        if cfg.model in ("bert", "gpt2", "moe"):
             kw["preset"] = cfg.model_preset
             if cfg.model_preset == "tiny" or cfg.dataset.startswith("synthetic"):
                 kw["vocab_size"] = max(self.train_data.num_classes, 4)
                 kw["max_seq_len"] = int(inputs.shape[1])
+        if cfg.model in ("bert", "gpt2") and cfg.microbatches:
+            kw["pipeline_microbatches"] = cfg.microbatches
         if cfg.param_dtype not in (None, "float32"):
             kw["param_dtype"] = jnp.dtype(cfg.param_dtype)
         return kw
@@ -168,13 +175,20 @@ class Trainer:
     def evaluate(self, epoch: int) -> dict:
         """Full eval pass == reference ``test`` (``main.py:70-95``), with the
         loss math fixed (§A.5) and padding double-counts accepted exactly as
-        the reference's DistributedSampler padding does."""
-        total = {"loss_sum": 0.0, "correct": 0, "count": 0}
+        the reference's DistributedSampler padding does.
+
+        Metrics accumulate *on device* (async scalar adds); the host fetches
+        once at the end instead of blocking on three transfers per batch."""
+        dev_total = None
         for x, y in self.eval_feed.epoch(0):
             m = self.eval_step(self.state, x, y)
-            total["loss_sum"] += float(m["loss_sum"])
-            total["correct"] += int(m["correct"])
-            total["count"] += int(m["count"])
+            dev_total = m if dev_total is None else \
+                jax.tree.map(jnp.add, dev_total, m)
+        total = ({"loss_sum": 0.0, "correct": 0, "count": 0}
+                 if dev_total is None else
+                 {"loss_sum": float(dev_total["loss_sum"]),
+                  "correct": int(dev_total["correct"]),
+                  "count": int(dev_total["count"])})
         loss = total["loss_sum"] / max(total["count"], 1)
         self.logger.eval_line(epoch, loss, total["correct"], total["count"])
         return {"loss": loss,

@@ -1,0 +1,139 @@
+"""Coordinator-only dataset download with barrier (reference
+``datasets.MNIST(download=True)``, ``main.py:107-108`` — minus its §A.8
+all-ranks race). Tested against a local HTTP server serving generated
+fixtures, so no network egress is ever needed.
+"""
+
+import functools
+import gzip
+import http.server
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.data.datasets import (
+    download_mnist, load_mnist)
+from tests.test_datasets import _write_idx_images, _write_idx_labels
+
+
+@pytest.fixture()
+def fixture_server(tmp_path):
+    """Serve generated idx.gz fixtures over local HTTP."""
+    src = tmp_path / "srv"
+    src.mkdir()
+    rng = np.random.default_rng(0)
+    for prefix, n in (("train", 12), ("t10k", 6)):
+        _write_idx_images(str(src / f"{prefix}-images-idx3-ubyte.gz"),
+                          rng.integers(0, 256, size=(n, 28, 28)).astype(
+                              np.uint8), gz=True)
+        _write_idx_labels(str(src / f"{prefix}-labels-idx1-ubyte.gz"),
+                          rng.integers(0, 10, size=n).astype(np.uint8),
+                          gz=True)
+    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                                directory=str(src))
+    server = http.server.ThreadingHTTPServer(("localhost", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://localhost:{server.server_address[1]}/"
+    server.shutdown()
+
+
+def test_download_then_load(tmp_path, fixture_server):
+    data_dir = str(tmp_path / "data")
+    assert download_mnist(data_dir, base_url=fixture_server)
+    raw = os.listdir(os.path.join(data_dir, "MNIST", "raw"))
+    assert len([f for f in raw if f.endswith(".gz")]) == 4
+    assert not [f for f in raw if f.endswith(".part")]
+    ds = load_mnist(data_dir, "train", synthetic_fallback=False)
+    assert ds.inputs.shape == (12, 28, 28, 1)
+    test = load_mnist(data_dir, "test", synthetic_fallback=False)
+    assert test.inputs.shape == (6, 28, 28, 1)
+
+
+def test_download_is_idempotent(tmp_path, fixture_server):
+    data_dir = str(tmp_path / "data")
+    assert download_mnist(data_dir, base_url=fixture_server)
+    before = {f: os.path.getmtime(os.path.join(data_dir, "MNIST", "raw", f))
+              for f in os.listdir(os.path.join(data_dir, "MNIST", "raw"))}
+    assert download_mnist(data_dir, base_url=fixture_server)
+    after = {f: os.path.getmtime(os.path.join(data_dir, "MNIST", "raw", f))
+             for f in os.listdir(os.path.join(data_dir, "MNIST", "raw"))}
+    assert before == after   # second call touches nothing
+
+
+def test_download_failure_degrades(tmp_path):
+    """Unreachable mirror: returns False, leaves no partial files, and
+    load_mnist still falls back to synthetic with the loud warning."""
+    data_dir = str(tmp_path / "data")
+    ok = download_mnist(data_dir, base_url="http://localhost:1/nope/",
+                        timeout=0.5)
+    assert not ok
+    raw = os.path.join(data_dir, "MNIST", "raw")
+    assert not [f for f in os.listdir(raw) if f.endswith(".part")]
+    with pytest.warns(UserWarning, match="NOT mnist metrics"):
+        ds = load_mnist(data_dir, "train", download=False)
+    assert "synthetic" in ds.name
+
+
+def test_download_cifar10_from_fixture_tarball(tmp_path):
+    """CIFAR-10 tarball fetch + extract against a local server."""
+    import io
+    import pickle
+    import tarfile
+
+    from distributed_compute_pytorch_tpu.data.datasets import (
+        download_cifar10, load_cifar10)
+
+    src = tmp_path / "srv"
+    src.mkdir()
+    rng = np.random.default_rng(1)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as t:
+        for fn in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            payload = pickle.dumps({
+                b"data": rng.integers(0, 256, size=(4, 3072)).astype(np.uint8),
+                b"labels": [int(v) for v in rng.integers(0, 10, size=4)]})
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{fn}")
+            info.size = len(payload)
+            t.addfile(info, io.BytesIO(payload))
+    (src / "cifar.tar.gz").write_bytes(buf.getvalue())
+
+    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                                directory=str(src))
+    server = http.server.ThreadingHTTPServer(("localhost", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        data_dir = str(tmp_path / "data")
+        url = f"http://localhost:{server.server_address[1]}/cifar.tar.gz"
+        assert download_cifar10(data_dir, url=url)
+        ds = load_cifar10(data_dir, "train", synthetic_fallback=False)
+        assert ds.inputs.shape == (20, 32, 32, 3)
+        assert download_cifar10(data_dir, url=url)   # idempotent
+    finally:
+        server.shutdown()
+
+
+def test_rejects_corrupt_payload(tmp_path):
+    """A mirror serving garbage must not install files."""
+    src = tmp_path / "srv"
+    src.mkdir()
+    for fn in ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+               "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"):
+        with gzip.open(src / fn, "wb") as f:
+            f.write(b"\xff\xffnot idx data")
+    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                                directory=str(src))
+    server = http.server.ThreadingHTTPServer(("localhost", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        data_dir = str(tmp_path / "data")
+        ok = download_mnist(
+            data_dir,
+            base_url=f"http://localhost:{server.server_address[1]}/")
+        assert not ok
+        raw = os.path.join(data_dir, "MNIST", "raw")
+        assert os.listdir(raw) == []
+    finally:
+        server.shutdown()

@@ -1,0 +1,139 @@
+"""Mixture-of-Experts + expert parallelism (makes the ``expert`` axis real).
+
+On the faked 8-device CPU mesh: routing invariants (capacity, drop
+accounting), expert-parallel sharding transparency (expert=4 == replicated
+run), learning, and Trainer reachability via the mesh spec.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.core.mesh import make_mesh, use_mesh
+from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+from distributed_compute_pytorch_tpu.models.moe import (
+    MoELayer, MoETransformerConfig, MoETransformerLM)
+from distributed_compute_pytorch_tpu.parallel.api import (
+    DataParallel, ShardingRules)
+from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+
+def test_moe_layer_shapes_and_aux():
+    layer = MoELayer(d_model=16, d_ff=32, num_experts=4, capacity_factor=2.0)
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 8, 16))
+    y, aux = layer.apply(params, x)
+    assert y.shape == x.shape
+    assert float(aux["lb_loss"]) >= 1.0 - 1e-5   # minimum at uniform routing
+    assert 0.0 <= float(aux["dropped_fraction"]) <= 1.0
+
+
+def test_moe_capacity_drops_overflow():
+    """With capacity far below tokens/expert, most tokens must be dropped
+    (zero contribution), never duplicated."""
+    layer = MoELayer(d_model=8, d_ff=16, num_experts=2,
+                     capacity_factor=0.125)
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 16, 8))
+    _, aux = layer.apply(params, x)
+    # 32 tokens, 2 experts, capacity = 2 -> at most 4 kept
+    assert float(aux["dropped_fraction"]) >= 1 - 4 / 32 - 1e-6
+
+
+def test_moe_identical_experts_match_dense_ffn():
+    """With every expert identical and capacity ample, the MoE output must
+    equal a single dense FFN — routing becomes irrelevant."""
+    layer = MoELayer(d_model=16, d_ff=32, num_experts=4, capacity_factor=8.0)
+    params = layer.init(jax.random.key(0))
+    # clone expert 0 into all experts
+    for k in ("w_in", "b_in", "w_out", "b_out"):
+        params[k] = jnp.broadcast_to(params[k][:1], params[k].shape)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 16))
+    y, aux = layer.apply(params, x)
+    h = jax.nn.gelu(x @ params["w_in"][0] + params["b_in"][0])
+    dense = h @ params["w_out"][0] + params["b_out"][0]
+    # gate scales the expert output: undo it for comparison
+    logits = (x.reshape(-1, 16) @ params["router"]["kernel"]).astype(jnp.float32)
+    gate = jnp.max(jax.nn.softmax(logits, -1), -1).reshape(2, 8, 1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense * gate),
+                               rtol=1e-4, atol=1e-5)
+    assert float(aux["dropped_fraction"]) == 0.0
+
+
+def test_expert_parallel_matches_replicated(devices8):
+    """expert=4 sharded run == fully replicated run: EP is numerically
+    transparent (the all-to-alls XLA inserts don't change the math)."""
+    data = synthetic_lm(32, seq_len=16, vocab=256, seed=6)
+    cfg = MoETransformerConfig.tiny()
+
+    def run(spec, strategy):
+        mesh = make_mesh(spec, devices=devices8)
+        model = MoETransformerLM(cfg)
+        feed = DeviceFeeder(data, mesh, 32, shuffle=False)
+        tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+        init_fn, train_step, eval_step = make_step_fns(model, tx, mesh,
+                                                       strategy)
+        state = init_fn(jax.random.key(0))
+        (x, y), = list(feed.epoch(0))
+        for _ in range(2):
+            state, m = train_step(state, x, y)
+        em = eval_step(state, x, y)
+        return jax.device_get(state.params), float(m["loss"]), \
+            float(em["loss_sum"]), state
+
+    model = MoETransformerLM(cfg)
+    rules = ShardingRules(rules=model.partition_rules(),
+                          fallback=DataParallel())
+    p_ref, l_ref, e_ref, _ = run("data=8", DataParallel())
+    p_ep, l_ep, e_ep, state = run("data=2,expert=4", rules)
+    np.testing.assert_allclose(l_ep, l_ref, rtol=2e-4)
+    np.testing.assert_allclose(e_ep, e_ref, rtol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_ep)):
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=3e-5)
+    # expert weights genuinely sharded: 4 experts / expert=4 -> 1 per device
+    w_in = state.params["blocks"]["moe"]["w_in"]   # [L, E, d, ff]
+    assert w_in.sharding.shard_shape(w_in.shape)[1] == 1
+
+
+def test_moe_lm_learns(devices8):
+    mesh = make_mesh("data=2,expert=4", devices=devices8)
+    cfg = MoETransformerConfig.tiny()
+    model = MoETransformerLM(cfg)
+    rules = ShardingRules(rules=model.partition_rules(),
+                          fallback=DataParallel())
+    data = synthetic_lm(64, seq_len=32, vocab=256, seed=7)
+    feed = DeviceFeeder(data, mesh, 64, shuffle=False)
+    tx = build_optimizer("adamw", lr=3e-3, gamma=1.0, steps_per_epoch=10,
+                         warmup_steps=2, total_steps=60)
+    init_fn, train_step, _ = make_step_fns(model, tx, mesh, rules)
+    state = init_fn(jax.random.key(0))
+    (x, y), = list(feed.epoch(0))
+    first = None
+    for i in range(30):
+        state, m = train_step(state, x, y)
+        if first is None:
+            first = float(m["loss"])
+        elif i % 10 == 0:
+            float(m["loss"])
+    assert float(m["loss"]) < first * 0.85, (first, float(m["loss"]))
+
+
+def test_trainer_mesh_spec_engages_moe(tmp_path):
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    data = synthetic_lm(64, seq_len=32, vocab=256, seed=8)
+    cfg = Config(batch_size=32, lr=3e-3, epochs=1, mesh="data=2,expert=4",
+                 model="moe", model_preset="tiny", dataset="synthetic-lm",
+                 optimizer="adamw", log_every=5,
+                 ckpt_path=str(tmp_path / "ck.npz"))
+    t = Trainer(cfg, train_data=data, eval_data=data)
+    assert isinstance(t.strategy, ShardingRules)
+    w_in = t.state.params["blocks"]["moe"]["w_in"]
+    assert w_in.sharding.shard_shape(w_in.shape)[1] == 1
+    res = t.fit()
+    assert np.isfinite(res["loss"])

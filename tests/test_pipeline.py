@@ -1,0 +1,148 @@
+"""Pipeline parallelism over the ``pipe`` axis (VERDICT r1 next-round #10).
+
+Numerics-transparency tests on the faked 8-device CPU mesh: the GPipe
+schedule in ``parallel/pipeline.py`` must produce bit-comparable results to
+the plain scanned forward, compose with data parallelism, differentiate
+correctly, and be reachable from the Trainer via the mesh spec alone.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.core.mesh import make_mesh, use_mesh
+from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.parallel.api import (
+    DataParallel, ShardingRules)
+from distributed_compute_pytorch_tpu.parallel.pipeline import (
+    num_layers, pipeline_blocks, scan_blocks, stacked_layers)
+from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+
+def _stacked_mlp(key, L=4, d=16):
+    """A minimal per-layer block for schedule-level tests."""
+    ks = jax.random.split(key, L)
+    per_layer = [{"w": jax.random.normal(k, (d, d)) * 0.3,
+                  "b": jnp.zeros((d,))} for k in ks]
+
+    def apply(p, x, *, rng=None, train=False):
+        del rng, train
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    return apply, stacked_layers(per_layer)
+
+
+@pytest.mark.parametrize("microbatches", [4, 8])
+def test_pipeline_matches_scan(devices8, microbatches):
+    """GPipe over pipe=4 == plain scan, for any microbatch count."""
+    mesh = make_mesh("data=2,pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (16, 8, 16))
+
+    ref = jax.jit(lambda p, x: scan_blocks(apply, p, x))(params, x)
+    piped = jax.jit(lambda p, x: pipeline_blocks(
+        apply, p, x, mesh, num_microbatches=microbatches))(params, x)
+    np.testing.assert_allclose(np.asarray(piped), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_gradients_match_scan(devices8):
+    """The backward pipeline (reverse schedule through ppermute+scan) must
+    produce the same gradients as the unpipelined computation."""
+    mesh = make_mesh("pipe=8", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(2), L=8)
+    x = jax.random.normal(jax.random.key(3), (8, 4, 16))
+
+    def loss_scan(p):
+        return scan_blocks(apply, p, x).sum()
+
+    def loss_pipe(p):
+        return pipeline_blocks(apply, p, x, mesh).sum()
+
+    g_ref = jax.jit(jax.grad(loss_scan))(params)
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_pipe)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_layer_count_validation(devices8):
+    mesh = make_mesh("pipe=8", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0), L=4)   # 4 % 8 != 0
+    x = jnp.zeros((8, 4, 16))
+    with pytest.raises(ValueError, match="not divisible by pipe"):
+        pipeline_blocks(apply, params, x, mesh)
+    apply8, params8 = _stacked_mlp(jax.random.key(0), L=8)
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_blocks(apply8, params8, jnp.zeros((6, 4, 16)), mesh,
+                        num_microbatches=4)
+
+
+def test_gpt2_pipeline_step_matches_dp(devices8):
+    """Full GPT-2 train steps on data=2,pipe=4 == pure DP — pipeline
+    parallelism is numerically transparent through the product step
+    function, params sharded stage-wise."""
+    data = synthetic_lm(32, seq_len=16, vocab=256, seed=4)
+    cfg = GPT2Config(vocab_size=256, max_seq_len=64, num_layers=4,
+                     num_heads=4, d_model=64, d_ff=128, dropout_rate=0.0)
+
+    def run(spec, strategy):
+        mesh = make_mesh(spec, devices=devices8)
+        model = GPT2(cfg)
+        feed = DeviceFeeder(data, mesh, 32, shuffle=False)
+        tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+        init_fn, train_step, eval_step = make_step_fns(model, tx, mesh,
+                                                       strategy)
+        state = init_fn(jax.random.key(0))
+        (x, y), = list(feed.epoch(0))
+        for _ in range(2):
+            state, m = train_step(state, x, y)
+        em = eval_step(state, x, y)
+        return (jax.device_get(state.params), float(m["loss"]),
+                float(em["loss_sum"]), state)
+
+    model = GPT2(cfg)
+    rules = ShardingRules(rules=model.partition_rules(),
+                          fallback=DataParallel())
+    p_ref, l_ref, e_ref, _ = run("data=8", DataParallel())
+    p_pipe, l_pipe, e_pipe, state = run("data=2,pipe=4", rules)
+    np.testing.assert_allclose(l_pipe, l_ref, rtol=2e-4)
+    np.testing.assert_allclose(e_pipe, e_ref, rtol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_pipe)):
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=3e-5)
+    # the stage dim is genuinely sharded: each device holds 1 of 4 layers
+    qkv = state.params["blocks"]["qkv"]["kernel"]
+    assert qkv.sharding.shard_shape(qkv.shape)[0] == 1
+
+
+def test_pipe_seq_combination_rejected(devices8):
+    mesh = make_mesh("pipe=2,seq=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0), L=4)
+    with pytest.raises(NotImplementedError, match="pipe and seq"):
+        pipeline_blocks(apply, params, jnp.zeros((4, 4, 16)), mesh)
+
+
+def test_trainer_mesh_spec_engages_pipeline(tmp_path):
+    """--mesh data=2,pipe=4 end-to-end through Trainer.fit(): loss drops
+    and the strategy shards the stacked layer dim."""
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    data = synthetic_lm(64, seq_len=32, vocab=256, seed=5)
+    # tiny preset has 2 layers -> pipe=2 stages of 1 layer each
+    cfg = Config(batch_size=32, lr=3e-3, epochs=1, mesh="data=4,pipe=2",
+                 model="gpt2", model_preset="tiny", dataset="synthetic-lm",
+                 optimizer="adamw", log_every=5,
+                 ckpt_path=str(tmp_path / "ck.npz"))
+    t = Trainer(cfg, train_data=data, eval_data=data)
+    assert isinstance(t.strategy, ShardingRules)
+    qkv = t.state.params["blocks"]["qkv"]["kernel"]
+    assert qkv.sharding.shard_shape(qkv.shape)[0] == 1  # 2 layers / pipe=2
+    res = t.fit()
+    assert np.isfinite(res["loss"])

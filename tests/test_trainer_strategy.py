@@ -54,17 +54,17 @@ def test_tensor_axis_actually_shards_qkv(tmp_path, lm_data):
     kernels, not silently replicated params (VERDICT r1 weak #4)."""
     t = Trainer(_cfg(tmp_path, "data=2,tensor=4"), train_data=lm_data,
                 eval_data=lm_data)
-    blk = t.state.params["blocks"][0]
-    d = 64  # GPT2Config.tiny d_model
+    blk = t.state.params["blocks"]   # stacked: leading [num_layers] dim
+    d, L = 64, 2  # GPT2Config.tiny d_model / num_layers
     # column-parallel fused qkv: output dim split 4 ways
     assert blk["qkv"]["kernel"].sharding.shard_shape(
-        blk["qkv"]["kernel"].shape) == (d, 3 * d // 4)
+        blk["qkv"]["kernel"].shape) == (L, d, 3 * d // 4)
     # row-parallel attn_out: input dim split 4 ways
     assert blk["attn_out"]["kernel"].sharding.shard_shape(
-        blk["attn_out"]["kernel"].shape) == (d // 4, d)
+        blk["attn_out"]["kernel"].shape) == (L, d // 4, d)
     # mlp_in column-parallel
     assert blk["mlp_in"]["kernel"].sharding.shard_shape(
-        blk["mlp_in"]["kernel"].shape) == (d, 128 // 4)
+        blk["mlp_in"]["kernel"].shape) == (L, d, 128 // 4)
 
 
 def test_trainer_tp_matches_dp_end_to_end(tmp_path, lm_data):
