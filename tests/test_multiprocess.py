@@ -33,6 +33,10 @@ def two_process_run(tmp_path_factory):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)   # worker sets its own
     env.pop("XLA_FLAGS", None)
+    # The worker script lives in tests/, so Python's auto sys.path entry is
+    # tests/ — make the repo root importable regardless of install state.
+    repo_root = os.path.dirname(os.path.dirname(_WORKER))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, str(i), "2", str(port), out_dir],
