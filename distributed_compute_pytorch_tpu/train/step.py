@@ -159,23 +159,36 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         return new_state, metrics
 
     @jax.jit
-    def eval_step(state: TrainState, x, y):
+    def eval_step(state: TrainState, x, y, acc=None):
         """Eval-batch metrics == reference ``test`` body (``main.py:78-86``).
 
         Returns device-side sums; the cross-replica ``all_reduce(SUM)`` of
         ``main.py:90-91`` is implicit in producing unsharded outputs.
+
+        ``acc``: optional metrics pytree from the previous batch, added into
+        the result *inside* the compiled step. Passing the running total back
+        in makes consecutive eval executions dataflow-dependent, which (a)
+        keeps the whole eval pass on device with one host fetch at the end
+        and (b) serialises the programs' collectives — independent eval
+        batches dispatched async can otherwise run concurrently and deadlock
+        the CPU backend's in-process rendezvous (XLA CPU collectives assume
+        one program at a time over the faked device set).
         """
         with use_mesh(mesh):
             out, _ = model.apply(_cast_params(state.params),
                                  state.model_state, _cast(x), train=False)
         if hasattr(model, "eval_metrics"):
-            return model.eval_metrics(out, y)
-        loss_sum = model.loss_sum(out, y) if hasattr(model, "loss_sum") else \
-            model.loss_fn(out, y) * x.shape[0]
-        pred = jnp.argmax(out, axis=-1)
-        correct = jnp.sum((pred == y).astype(jnp.int32))
-        return {"loss_sum": loss_sum.astype(jnp.float32),
-                "correct": correct,
-                "count": jnp.asarray(x.shape[0], jnp.int32)}
+            metrics = model.eval_metrics(out, y)
+        else:
+            loss_sum = model.loss_sum(out, y) if hasattr(model, "loss_sum") \
+                else model.loss_fn(out, y) * x.shape[0]
+            pred = jnp.argmax(out, axis=-1)
+            correct = jnp.sum((pred == y).astype(jnp.int32))
+            metrics = {"loss_sum": loss_sum.astype(jnp.float32),
+                       "correct": correct,
+                       "count": jnp.asarray(x.shape[0], jnp.int32)}
+        if acc is not None:
+            metrics = jax.tree.map(jnp.add, metrics, acc)
+        return metrics
 
     return init_fn, train_step, eval_step

@@ -177,13 +177,30 @@ class Trainer:
         loss math fixed (§A.5) and padding double-counts accepted exactly as
         the reference's DistributedSampler padding does.
 
-        Metrics accumulate *on device* (async scalar adds); the host fetches
-        once at the end instead of blocking on three transfers per batch."""
+        Metrics accumulate *on device*, threaded through ``eval_step`` as a
+        carry; the host fetches once at the end instead of blocking on three
+        transfers per batch.
+
+        On the CPU backend we additionally block per batch: eval executions
+        are independent up to the final accumulate (params and batch are both
+        ready), so async dispatch runs several collective-bearing programs
+        concurrently — which deadlocks XLA:CPU's in-process rendezvous when
+        the host is thread-starved (observed on a 1-core host with 8 faked
+        devices; the train loop is immune because each step consumes the
+        previous step's donated state). TPU executes programs in order, so
+        the async pipeline is kept there."""
+        serialize = self.mesh.devices.flat[0].platform == "cpu"
         dev_total = None
         for x, y in self.eval_feed.epoch(0):
-            m = self.eval_step(self.state, x, y)
-            dev_total = m if dev_total is None else \
-                jax.tree.map(jnp.add, dev_total, m)
+            if dev_total is None:
+                # zero-seed the carry so every batch hits the same compiled
+                # program (an acc=None first call would compile eval twice)
+                shapes = jax.eval_shape(self.eval_step, self.state, x, y)
+                dev_total = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+            dev_total = self.eval_step(self.state, x, y, dev_total)
+            if serialize:
+                jax.block_until_ready(dev_total)
         total = ({"loss_sum": 0.0, "correct": 0, "count": 0}
                  if dev_total is None else
                  {"loss_sum": float(dev_total["loss_sum"]),
