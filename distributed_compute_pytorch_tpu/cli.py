@@ -14,14 +14,31 @@ No process spawning: where the reference forked one process per device
 mesh.
 """
 
+import sys
+
 from distributed_compute_pytorch_tpu.core.config import Config
 from distributed_compute_pytorch_tpu.train.trainer import Trainer
 
 
 def main(argv=None):
     config = Config.from_argv(argv)
+    if config.supervise:
+        # parent mode: re-run this CLI as a supervised child (without
+        # --supervise), restarting it with --resume on crash/hang/preemption
+        from distributed_compute_pytorch_tpu.train.elastic import supervise
+        raw = list(sys.argv[1:] if argv is None else argv)
+        child = [a for a in raw if a != "--supervise"]
+        rc = supervise(["-m", "distributed_compute_pytorch_tpu.cli", *child],
+                       max_restarts=config.max_restarts,
+                       heartbeat_path=config.heartbeat_path,
+                       heartbeat_timeout=config.heartbeat_timeout)
+        sys.exit(rc)
     trainer = Trainer(config)
     result = trainer.fit()
+    if result.get("preempted"):
+        from distributed_compute_pytorch_tpu.train.elastic import (
+            EXIT_PREEMPTED)
+        sys.exit(EXIT_PREEMPTED)
     return result
 
 

@@ -63,6 +63,17 @@ class Config:
     ckpt_path: str = "checkpoint.npz"  # reference writes 'mnist.pt' (main.py:133)
     resume: bool = False           # restore path the reference lacks (SURVEY §5.4)
 
+    # --- elastic / fault tolerance (SURVEY §5.3; the reference has none) ---
+    checkpoint_every: int = 0      # also checkpoint every N steps (0 = per-epoch
+                                   # only); resume restarts mid-epoch exactly
+    heartbeat_path: str | None = None  # liveness file, touched at log cadence
+    supervise: bool = False        # run under the restart supervisor
+    max_restarts: int = 3          # supervisor restart budget
+    heartbeat_timeout: float = 300.0   # supervisor hang detection threshold (s)
+    fault_at_step: int | None = None   # fault injection: trip at global step N
+    fault_mode: str = "raise"      # 'raise' (crash) | 'hang' (stuck collective
+                                   # stand-in); first incarnation only
+
     # --- distributed rendezvous (replaces main.py:48-49 hard-coding) ---
     coordinator: str | None = field(
         default_factory=lambda: _env("DCP_COORDINATOR"))
@@ -95,7 +106,10 @@ class Config:
     def parser(cls) -> argparse.ArgumentParser:
         p = argparse.ArgumentParser(
             description="TPU-native distributed trainer "
-                        "(capability parity with reference main.py)")
+                        "(capability parity with reference main.py)",
+            # no prefix abbreviation: an abbreviated '--superv' surviving the
+            # supervisor's child-argv filter would recurse into supervisors
+            allow_abbrev=False)
         p.add_argument("--batch_size", type=int, default=cls.batch_size,
                        help="global batch size of train and test")
         p.add_argument("--lr", type=float, default=cls.lr, help="LR of optimizer")
@@ -125,6 +139,23 @@ class Config:
                             "only, like the reference's download=True)")
         p.add_argument("--ckpt_path", type=str, default=cls.ckpt_path)
         p.add_argument("--resume", action="store_true")
+        p.add_argument("--checkpoint_every", type=int,
+                       default=cls.checkpoint_every,
+                       help="also checkpoint every N steps (0 = per-epoch "
+                            "only); resume restarts mid-epoch")
+        p.add_argument("--heartbeat_path", type=str, default=None,
+                       help="liveness file for external failure detection")
+        p.add_argument("--supervise", action="store_true",
+                       help="run under the restart supervisor (auto --resume "
+                            "after crash/hang/preemption)")
+        p.add_argument("--max_restarts", type=int, default=cls.max_restarts)
+        p.add_argument("--heartbeat_timeout", type=float,
+                       default=cls.heartbeat_timeout)
+        p.add_argument("--fault_at_step", type=int, default=None,
+                       help="fault injection (testing): trip at global step N "
+                            "in the first incarnation")
+        p.add_argument("--fault_mode", type=str, default=cls.fault_mode,
+                       choices=("raise", "hang"))
         p.add_argument("--coordinator", type=str, default=None,
                        help="host:port of process 0 (multi-host rendezvous)")
         p.add_argument("--num_processes", type=int, default=None)

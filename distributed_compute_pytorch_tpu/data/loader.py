@@ -99,9 +99,17 @@ class DeviceFeeder:
     def steps_per_epoch(self) -> int:
         return self.sampler.num_batches
 
-    def epoch(self, epoch: int = 0) -> Iterator[tuple[jax.Array, jax.Array]]:
-        """Yield ``(inputs, targets)`` global arrays for one epoch."""
+    def epoch(self, epoch: int = 0, skip: int = 0
+              ) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Yield ``(inputs, targets)`` global arrays for one epoch.
+
+        ``skip`` drops the first N batches of the (deterministic) epoch
+        order — mid-epoch resume lands on exactly the batch the checkpoint
+        interrupted, because the order is a pure function of (seed, epoch).
+        """
         order = self.sampler.epoch_order(epoch)
+        if skip:
+            order = order[skip:]
         in_shape = (self.global_batch, *self.dataset.inputs.shape[1:])
         tgt_shape = (self.global_batch, *self.dataset.targets.shape[1:])
         in_rows = _local_row_span(self.input_sharding, in_shape)

@@ -18,8 +18,6 @@ plain numpy.
 from __future__ import annotations
 
 import json
-import os
-import tempfile
 from typing import Any
 
 import jax
@@ -27,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_compute_pytorch_tpu.core.mesh import is_coordinator
+from distributed_compute_pytorch_tpu.utils.fsio import atomic_write
 
 PyTree = Any
 _FORMAT_VERSION = 1
@@ -75,17 +74,9 @@ def save(path: str, state, *, epoch: int = 0, extra: dict | None = None) -> None
     flat = _flatten(host_tree)
     manifest = {"format": _FORMAT_VERSION, "epoch": epoch,
                 "extra": extra or {}}
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, __manifest__=json.dumps(manifest), **flat)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    atomic_write(path,
+                 lambda f: np.savez(f, __manifest__=json.dumps(manifest),
+                                    **flat))
 
 
 def load_manifest(path: str) -> dict:

@@ -1,0 +1,221 @@
+"""Failure detection, preemption handling, and supervised restart.
+
+The reference has no fault story at all (SURVEY §5.3): a static world size
+fixed at launch (``/root/reference/main.py:148,150``), ``mp.spawn(join=True)``
+that merely propagates a child crash, and any rank's death hangs the others
+at the next collective (``main.py:65``). The minimum viable elastic story for
+a TPU SPMD design is *fail-fast + restart-from-checkpoint*, and that is what
+this module provides, as three cooperating pieces:
+
+- :class:`PreemptionGuard` — turns SIGTERM/SIGINT into a flag the trainer
+  polls between steps, so a preempted run checkpoints *mid-epoch* and exits
+  with :data:`EXIT_PREEMPTED` instead of dying inside a device step. TPU
+  pools send exactly this signal ahead of reclaiming a VM.
+- :class:`Heartbeat` — a liveness file the trainer touches at the logging
+  cadence. Liveness is observable from *outside* the process, which is the
+  failure-detection half the reference lacks (a hung collective looks
+  exactly like a long step from inside).
+- :func:`supervise` — a parent loop that runs the trainer as a child
+  process, watches the heartbeat, kills a hung child, and restarts a failed
+  or killed one with ``--resume`` (bounded by ``max_restarts``). Together
+  with step-granular checkpointing (``--checkpoint_every``) this gives
+  crash/hang/preemption recovery that loses at most ``checkpoint_every``
+  steps of work.
+
+Fault injection (``--fault_at_step`` / ``--fault_mode``) is part of the
+subsystem: an injected crash or hang exercises the exact recovery path in
+tests, gated to the first incarnation via ``DCP_RESTART_COUNT`` so the
+restarted run proceeds cleanly.
+
+Multi-host note: preemption checkpoints and heartbeats are per-process;
+checkpoint.save() is a collective, so coordinated preemption (the cluster
+manager signalling every host, as GCE/TPU maintenance events do) is assumed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Sequence
+
+from distributed_compute_pytorch_tpu.utils.fsio import atomic_write
+
+# child exit code meaning "preempted after a clean checkpoint" (EX_TEMPFAIL:
+# transient, safe to restart)
+EXIT_PREEMPTED = 75
+
+
+class Preempted(Exception):
+    """Raised by the trainer after a preemption checkpoint has been written."""
+
+
+def restart_count() -> int:
+    """Which incarnation this process is (0 = first launch). Set by
+    :func:`supervise` in the child environment."""
+    try:
+        return int(os.environ.get("DCP_RESTART_COUNT", "0"))
+    except ValueError:
+        return 0
+
+
+class PreemptionGuard:
+    """Latches SIGTERM/SIGINT into a poll-able flag.
+
+    Use as a context manager around the epoch loop; the previous handlers
+    are restored on exit. The first signal sets the flag (the trainer
+    finishes the in-flight step, checkpoints, and exits); a second signal
+    falls through to the previous handler, so a double Ctrl-C still kills a
+    stuck run.
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._previous: dict[int, object] = {}
+        self.preempted = False
+
+    def _handler(self, signum, frame):
+        if self.preempted:  # second signal: behave like the original handler
+            prev = self._previous.get(signum)
+            signal.signal(signum, prev if callable(prev) else signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self.preempted = True
+
+    def __enter__(self) -> "PreemptionGuard":
+        for s in self._signals:
+            self._previous[s] = signal.signal(s, self._handler)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+
+
+class Heartbeat:
+    """Atomic JSON liveness file: ``{"ts": ..., "epoch": ..., "step": ...}``.
+
+    ``beat()`` is cheap enough for the logging cadence (one tmpfile write +
+    rename); readers (:func:`supervise`, dashboards) never see a torn file.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+
+    def beat(self, epoch: int = 0, step: int = 0) -> None:
+        atomic_write(
+            self.path,
+            lambda f: json.dump({"ts": time.time(), "epoch": epoch,
+                                 "step": step}, f),
+            mode="w", suffix=".hb")
+
+    @staticmethod
+    def read(path: str) -> dict | None:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    @staticmethod
+    def age(path: str) -> float | None:
+        """Seconds since the last beat, or None if no beat yet."""
+        hb = Heartbeat.read(path)
+        return None if hb is None else max(0.0, time.time() - hb["ts"])
+
+
+def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,
+              heartbeat_path: str | None = None,
+              heartbeat_timeout: float = 300.0,
+              poll_interval: float = 0.5,
+              kill_grace: float = 10.0) -> int:
+    """Run ``child_argv`` under restart supervision; returns the exit code.
+
+    The child is restarted (with ``--resume`` appended, so it picks up the
+    latest checkpoint) when it exits nonzero or when its heartbeat goes
+    stale (hang detection: the child is SIGTERMed, then SIGKILLed after
+    ``kill_grace`` seconds). Crashes and hangs consume the ``max_restarts``
+    budget; clean preemptions (:data:`EXIT_PREEMPTED` — checkpointed,
+    transient by definition) restart for free, so a preemptible pool can
+    bounce the run indefinitely. ``DCP_RESTART_COUNT`` tells each
+    incarnation which attempt it is. Staleness is only judged once *this*
+    child has beaten at least once, so XLA compiles before the first step
+    don't count as hangs (a hang before the first beat is therefore
+    undetectable — set ``heartbeat_timeout`` to cover eval passes, during
+    which the trainer also beats). SIGTERM/SIGINT to the supervisor forward
+    to the child (which preempt-checkpoints) and end supervision with the
+    child's exit code instead of restarting.
+    """
+    argv = [sys.executable, *child_argv]
+    restarts = 0      # failures only; clean preemptions restart for free
+    attempt = 0
+    stopping = {"flag": False}
+    child: dict[str, subprocess.Popen | None] = {"proc": None}
+
+    def _forward(signum, frame):
+        # supervisor killed: pass the signal to the child (it preempt-
+        # checkpoints) and stop supervising instead of restarting
+        stopping["flag"] = True
+        p = child["proc"]
+        if p is not None and p.poll() is None:
+            p.send_signal(signum)
+
+    prev_handlers = {s: signal.signal(s, _forward)
+                     for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        while True:
+            env = dict(os.environ, DCP_RESTART_COUNT=str(attempt))
+            cmd = list(argv)
+            if attempt > 0 and "--resume" not in cmd:
+                cmd.append("--resume")
+            child["proc"] = proc = subprocess.Popen(cmd, env=env)
+            hung = False
+            baseline = (Heartbeat.read(heartbeat_path)
+                        if heartbeat_path else None)
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                if heartbeat_path is not None and not stopping["flag"]:
+                    hb = Heartbeat.read(heartbeat_path)
+                    fresh = hb is not None and hb != baseline
+                    if fresh and (time.time() - hb["ts"]) > heartbeat_timeout:
+                        hung = True
+                        print(f"[supervise] heartbeat stale "
+                              f"(> {heartbeat_timeout:.0f}s); killing child",
+                              file=sys.stderr, flush=True)
+                        proc.terminate()
+                        try:
+                            rc = proc.wait(timeout=kill_grace)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                            rc = proc.wait()
+                        break
+                time.sleep(poll_interval)
+            attempt += 1
+            if (rc == 0 and not hung) or stopping["flag"]:
+                return rc
+            if rc == EXIT_PREEMPTED:
+                # clean preemption: checkpointed, transient by definition —
+                # restarting it must not consume the failure budget
+                print(f"[supervise] child preempted (exit {rc}); "
+                      f"restarting with --resume", file=sys.stderr, flush=True)
+                continue
+            restarts += 1
+            if restarts > max_restarts:
+                print(f"[supervise] giving up after {max_restarts} restarts "
+                      f"(last exit {rc})", file=sys.stderr, flush=True)
+                return rc if rc else 1
+            why = "hang" if hung else f"exit {rc}"
+            print(f"[supervise] child died ({why}); restart "
+                  f"{restarts}/{max_restarts} with --resume",
+                  file=sys.stderr, flush=True)
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)

@@ -31,6 +31,8 @@ from distributed_compute_pytorch_tpu.models.registry import build_model
 from distributed_compute_pytorch_tpu.parallel.api import (
     DataParallel, FSDP, ShardingRules)
 from distributed_compute_pytorch_tpu.train import checkpoint
+from distributed_compute_pytorch_tpu.train.elastic import (
+    Heartbeat, Preempted, PreemptionGuard, restart_count)
 from distributed_compute_pytorch_tpu.train.optim import build_optimizer
 from distributed_compute_pytorch_tpu.train.step import make_step_fns
 from distributed_compute_pytorch_tpu.utils.logging import MetricLogger, log0
@@ -91,6 +93,7 @@ class Trainer:
 
         self.state = self.init_fn(jax.random.key(config.seed))
         self.start_epoch = 0
+        self.start_step = 0            # step within start_epoch (mid-epoch resume)
         if config.resume and os.path.exists(config.ckpt_path):
             manifest = checkpoint.load_manifest(config.ckpt_path)
             # restore each leaf straight into its strategy layout — the
@@ -98,8 +101,21 @@ class Trainer:
             shardings = jax.tree.map(lambda a: a.sharding, self.state)
             self.state = checkpoint.restore(config.ckpt_path, self.state,
                                             shardings=shardings)
-            self.start_epoch = int(manifest["epoch"]) + 1
-            log0(f"resumed from {config.ckpt_path} at epoch {self.start_epoch}")
+            epoch = int(manifest["epoch"])
+            step_in_epoch = int(manifest.get("extra", {})
+                                .get("step_in_epoch", -1))
+            if 0 <= step_in_epoch < self.train_feed.steps_per_epoch:
+                # a --checkpoint_every / preemption checkpoint: land on the
+                # exact next batch of the deterministic epoch order
+                self.start_epoch, self.start_step = epoch, step_in_epoch
+                log0(f"resumed from {config.ckpt_path} at epoch {epoch} "
+                     f"step {step_in_epoch}")
+            else:
+                self.start_epoch = epoch + 1
+                log0(f"resumed from {config.ckpt_path} at epoch "
+                     f"{self.start_epoch}")
+        self.heartbeat = (Heartbeat(config.heartbeat_path)
+                          if config.heartbeat_path else None)
 
         self.logger = MetricLogger()
         log0(f"mesh: {dict(self.mesh.shape)} | dp world size: "
@@ -153,24 +169,62 @@ class Trainer:
             kw["param_dtype"] = jnp.dtype(cfg.param_dtype)
         return kw
 
-    def train_epoch(self, epoch: int) -> float:
-        """One epoch; returns mean wall-time-throughput (samples/s)."""
+    def train_epoch(self, epoch: int, skip: int = 0,
+                    guard: PreemptionGuard | None = None) -> float:
+        """One epoch; returns mean wall-time-throughput (samples/s).
+
+        ``skip`` resumes mid-epoch (first incarnation passes 0);
+        ``guard`` polls for preemption between steps — on a signal the
+        current position is checkpointed and :class:`Preempted` raised.
+        """
         cfg = self.config
         timer = Timer()
         steps = self.train_feed.steps_per_epoch
-        for b, (x, y) in enumerate(self.train_feed.epoch(epoch)):
+        metrics = None
+        for b, (x, y) in enumerate(self.train_feed.epoch(epoch, skip=skip),
+                                   start=skip):
+            self._maybe_inject_fault(epoch * steps + b)
             self.state, metrics = self.train_step(self.state, x, y)
             if b % cfg.log_every == 0:
                 # read the device scalar only at the logging cadence
                 # (reference cadence, main.py:64)
                 self.logger.train_line(epoch, b, steps,
                                        float(metrics["loss"]))
+                if self.heartbeat is not None:
+                    self.heartbeat.beat(epoch, epoch * steps + b)
+            if guard is not None and guard.preempted:
+                checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
+                                extra={"step_in_epoch": b + 1})
+                log0(f"preempted at epoch {epoch} step {b}; "
+                     f"checkpoint written to {cfg.ckpt_path}")
+                raise Preempted()
+            if (cfg.checkpoint_every
+                    and (b + 1) % cfg.checkpoint_every == 0
+                    and b + 1 < steps):
+                checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
+                                extra={"step_in_epoch": b + 1})
         # fence via a device->host fetch of a value depending on the last
         # step: block_until_ready can ack early on relayed TPU transports,
         # which would overstate samples/s (bench.py uses the same fence)
-        np.asarray(metrics["loss"])
+        if metrics is not None:
+            np.asarray(metrics["loss"])
         secs = timer.elapsed()
-        return steps * cfg.batch_size / secs
+        return (steps - skip) * cfg.batch_size / secs
+
+    def _maybe_inject_fault(self, global_step: int) -> None:
+        """Fault injection for exercising the recovery path (elastic.py):
+        trips once, in the first incarnation only."""
+        cfg = self.config
+        if cfg.fault_at_step is None or restart_count() > 0:
+            return
+        if global_step == cfg.fault_at_step:
+            if cfg.fault_mode == "hang":
+                import time
+                log0(f"injected hang at step {global_step} (--fault_at_step)")
+                while True:                      # stuck-collective stand-in
+                    time.sleep(1)
+            raise RuntimeError(
+                f"injected fault at step {global_step} (--fault_at_step)")
 
     def evaluate(self, epoch: int) -> dict:
         """Full eval pass == reference ``test`` (``main.py:70-95``), with the
@@ -191,7 +245,9 @@ class Trainer:
         the async pipeline is kept there."""
         serialize = self.mesh.devices.flat[0].platform == "cpu"
         dev_total = None
-        for x, y in self.eval_feed.epoch(0):
+        for b, (x, y) in enumerate(self.eval_feed.epoch(0)):
+            if self.heartbeat is not None and b % self.config.log_every == 0:
+                self.heartbeat.beat(epoch, b)   # stay live through eval
             if dev_total is None:
                 # zero-seed the carry so every batch hits the same compiled
                 # program (an acc=None first call would compile eval twice)
@@ -213,15 +269,36 @@ class Trainer:
 
     def fit(self) -> dict:
         """The reference's epoch loop (``main.py:127-133``): train -> eval ->
-        (schedule is compiled in) -> timing print -> checkpoint at the end."""
+        (schedule is compiled in) -> timing print -> checkpoint at the end.
+
+        Runs under a :class:`PreemptionGuard`: SIGTERM/SIGINT checkpoints
+        mid-epoch and returns ``{"preempted": True}`` (the CLI exits with
+        ``EXIT_PREEMPTED`` so a supervisor restarts-with-resume)."""
         cfg = self.config
         last_eval = {}
-        with maybe_profile(cfg.profile_dir):
+        # NOTE: no heartbeat before the first step — a pre-compile beat
+        # would arm the supervisor's staleness timer and a long XLA compile
+        # would then read as a hang
+        with maybe_profile(cfg.profile_dir), PreemptionGuard() as guard:
             for epoch in range(self.start_epoch, cfg.epochs):
+                skip = self.start_step if epoch == self.start_epoch else 0
                 timer = Timer()
-                throughput = self.train_epoch(epoch)
+                try:
+                    throughput = self.train_epoch(epoch, skip=skip,
+                                                  guard=guard)
+                except Preempted:
+                    self.logger.close()
+                    return {"preempted": True, "epoch": epoch}
                 last_eval = self.evaluate(epoch)
                 self.logger.epoch_time(epoch, timer.elapsed(), throughput)
                 checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch)
+                if guard.preempted:
+                    # signal arrived during eval/save: the epoch checkpoint
+                    # just written is the resume point — exit now rather
+                    # than starting another epoch
+                    log0(f"preempted during epoch {epoch} eval; epoch "
+                         f"checkpoint written to {cfg.ckpt_path}")
+                    self.logger.close()
+                    return {"preempted": True, "epoch": epoch}
         self.logger.close()
         return last_eval

@@ -1,0 +1,28 @@
+"""Filesystem helpers shared by checkpointing and liveness files."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, IO
+
+
+def atomic_write(path: str, write: Callable[[IO], None], mode: str = "wb",
+                 suffix: str = ".tmp") -> None:
+    """Write via a same-directory tempfile + ``os.replace``.
+
+    Readers never observe a torn file, and a crash mid-write leaves the
+    previous version intact (the reference's every-rank ``torch.save`` to one
+    path — ``/root/reference/main.py:133`` — has neither property).
+    """
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=suffix)
+    try:
+        with os.fdopen(fd, mode) as f:
+            write(f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
