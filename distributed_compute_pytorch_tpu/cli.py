@@ -39,8 +39,10 @@ def main(argv=None):
         from distributed_compute_pytorch_tpu.train.elastic import (
             EXIT_PREEMPTED)
         sys.exit(EXIT_PREEMPTED)
-    return result
+    # the console script does sys.exit(main()): 0 = clean (returning the
+    # metrics dict would exit 1 and break `dcp-train && ...` chains)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

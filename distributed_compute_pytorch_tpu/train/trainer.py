@@ -94,6 +94,7 @@ class Trainer:
         self.state = self.init_fn(jax.random.key(config.seed))
         self.start_epoch = 0
         self.start_step = 0            # step within start_epoch (mid-epoch resume)
+        self._resumed = False
         if config.resume and os.path.exists(config.ckpt_path):
             manifest = checkpoint.load_manifest(config.ckpt_path)
             # restore each leaf straight into its strategy layout — the
@@ -101,6 +102,7 @@ class Trainer:
             shardings = jax.tree.map(lambda a: a.sharding, self.state)
             self.state = checkpoint.restore(config.ckpt_path, self.state,
                                             shardings=shardings)
+            self._resumed = True
             epoch = int(manifest["epoch"])
             step_in_epoch = int(manifest.get("extra", {})
                                 .get("step_in_epoch", -1))
@@ -213,9 +215,10 @@ class Trainer:
 
     def _maybe_inject_fault(self, global_step: int) -> None:
         """Fault injection for exercising the recovery path (elastic.py):
-        trips once, in the first incarnation only."""
+        trips once — never in a supervised restart (DCP_RESTART_COUNT) nor
+        in a manual --resume, which would otherwise crash-loop."""
         cfg = self.config
-        if cfg.fault_at_step is None or restart_count() > 0:
+        if cfg.fault_at_step is None or restart_count() > 0 or self._resumed:
             return
         if global_step == cfg.fault_at_step:
             if cfg.fault_mode == "hang":

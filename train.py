@@ -2,7 +2,9 @@
 """Repo-root launcher shim; the real CLI lives in
 ``distributed_compute_pytorch_tpu.cli`` (installed as ``dcp-train``)."""
 
+import sys
+
 from distributed_compute_pytorch_tpu.cli import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
