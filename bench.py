@@ -266,8 +266,10 @@ def _bench_attention(jax, jnp, np):
         ks = jax.random.split(jax.random.key(0), 3)
         q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.bfloat16)
                    for kk in ks)
+        from distributed_compute_pytorch_tpu.ops.attention import _pick_block
+        blk = _pick_block(T)
         fl_ms = scan_time(lambda q, k, v: flash_attention(
-            q, k, v, causal=True, block_q=512, block_k=512), q, k, v)
+            q, k, v, causal=True, block_q=blk, block_k=blk), q, k, v)
         de_ms = scan_time(lambda q, k, v: dot_product_attention(
             q, k, v, causal=True), q, k, v)
         out[f"t{T}"] = {"batch": B, "heads": H, "head_dim": D,

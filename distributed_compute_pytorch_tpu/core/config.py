@@ -86,6 +86,9 @@ class Config:
     compute_dtype: str = "float32"   # bfloat16 for TPU speed; float32 for parity tests
     param_dtype: str = "float32"
     donate: bool = True              # donate train-state buffers to the jitted step
+    remat: bool = False              # rematerialise transformer blocks on backward
+                                     # (jax.checkpoint): trades one extra forward
+                                     # for ~2-4x batch when HBM binds
     profile_dir: str | None = None   # opt-in XLA profiler traces (SURVEY §5.1)
 
     # --- eval behaviour: reference evaluates on the TRAIN set (main.py:130, bug §A.1).
@@ -162,6 +165,9 @@ class Config:
         p.add_argument("--process_id", type=int, default=None)
         p.add_argument("--compute_dtype", type=str, default=cls.compute_dtype)
         p.add_argument("--param_dtype", type=str, default=cls.param_dtype)
+        p.add_argument("--remat", action="store_true",
+                       help="rematerialise transformer blocks on backward "
+                            "(bigger batches when HBM binds)")
         p.add_argument("--profile_dir", type=str, default=None)
         p.add_argument("--eval_on_train", action="store_true",
                        help="replicate reference bug §A.1 (eval on train split)")

@@ -40,6 +40,7 @@ class BertConfig:
     mask_token_id: int = 103       # [MASK] in the WordPiece vocab
     # GPipe microbatch count under a pipe axis (None = pipe size)
     pipeline_microbatches: int | None = None
+    remat: bool = False            # rematerialise blocks on backward
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -96,9 +97,9 @@ class BertMLM:
                 and mesh.shape["pipe"] > 1):
             x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
                                 num_microbatches=c.pipeline_microbatches,
-                                rng=layers_rng, train=train)
+                                rng=layers_rng, train=train, remat=c.remat)
         else:
-            x = scan_blocks(block.apply, params["blocks"], x,
+            x = scan_blocks(block.apply, params["blocks"], x, remat=c.remat,
                             rng=layers_rng, train=train)
         h = L.Dense(c.d_model, c.d_model).apply(params["mlm_dense"], x)
         h = jax.nn.gelu(h)

@@ -42,6 +42,10 @@ class GPT2Config:
     # GPipe microbatch count under a pipe axis (None = pipe size). Bubble
     # fraction is (P-1)/(M+P-1): raise M to amortise.
     pipeline_microbatches: int | None = None
+    # rematerialise blocks on backward (jax.checkpoint): ~2-4x batch for one
+    # extra forward — the HBM-bound trade (proven: B=32 GPT-2-small fits one
+    # v5e chip with remat; B=16 doesn't without)
+    remat: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -102,10 +106,10 @@ class GPT2:
                 and mesh.shape["pipe"] > 1):
             x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
                                 num_microbatches=c.pipeline_microbatches,
-                                rng=layers_rng, train=train)
+                                rng=layers_rng, train=train, remat=c.remat)
         else:
             x = scan_blocks(block.apply, params["blocks"], x,
-                            rng=layers_rng, train=train)
+                            rng=layers_rng, train=train, remat=c.remat)
         x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
         logits = wte.attend(params["wte"], x)  # weight-tied readout
         return logits, state

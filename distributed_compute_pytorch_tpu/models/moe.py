@@ -141,6 +141,7 @@ class MoETransformerConfig:
     lb_weight: float = 0.01
     z_weight: float = 1e-3
     dropout_rate: float = 0.0
+    remat: bool = False            # rematerialise blocks on backward
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -221,13 +222,17 @@ class MoETransformerLM:
         x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"],
                                                          jnp.arange(T))
         L_n = c.num_layers
+        # prevent_cse=False: safe under scan-over-layers (see scan_blocks)
+        block_apply = (jax.checkpoint(self._block_apply, static_argnums=(3,),
+                                      prevent_cse=False)
+                       if c.remat else self._block_apply)
 
         def body(carry, scanned):
             h, lb, z = carry
             i, p = scanned
             r = (jax.random.fold_in(rng, i)
                  if (rng is not None and train) else None)
-            h, aux = self._block_apply(p, h, r, train)
+            h, aux = block_apply(p, h, r, train)
             return (h, lb + aux["lb_loss"], z + aux["z_loss"]), None
 
         (x, lb, z), _ = jax.lax.scan(

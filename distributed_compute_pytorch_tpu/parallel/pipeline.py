@@ -45,19 +45,35 @@ def num_layers(stacked_params) -> int:
 
 
 def scan_blocks(block_apply, stacked_params, x, *, rng=None,
-                train: bool = False):
+                train: bool = False, remat: bool = False):
     """Apply ``L`` stacked layers sequentially via ``lax.scan``.
 
     ``block_apply(layer_params, x, rng, train) -> x``. Per-layer dropout
     keys are ``fold_in(rng, layer_index)``.
+
+    ``remat``: rematerialise each block on the backward pass
+    (``jax.checkpoint``) — activation memory drops from every
+    intermediate per layer to one residual per layer, buying ~2-4x batch
+    at the cost of one extra forward. The standard TPU trade when HBM,
+    not FLOPs, binds.
     """
     L = num_layers(stacked_params)
+    apply = block_apply
+    if remat:
+        # prevent_cse=False: scan-over-layers already rules out the unsound
+        # CSE that checkpoint's optimization barriers guard against, and the
+        # barriers would block fusion on exactly the HBM-bound runs that
+        # turn remat on
+        ck = jax.checkpoint(
+            lambda p, h, r, t: block_apply(p, h, rng=r, train=t),
+            static_argnums=(3,), prevent_cse=False)
+        apply = lambda p, h, rng=None, train=False: ck(p, h, rng, train)
 
     def body(h, scanned):
         i, p = scanned
         r = (jax.random.fold_in(rng, i)
              if (rng is not None and train) else None)
-        return block_apply(p, h, rng=r, train=train), None
+        return apply(p, h, rng=r, train=train), None
 
     h, _ = lax.scan(body, x, (jnp.arange(L), stacked_params))
     return h
@@ -65,7 +81,7 @@ def scan_blocks(block_apply, stacked_params, x, *, rng=None,
 
 def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
                     axis: str = "pipe", *, num_microbatches: int | None = None,
-                    rng=None, train: bool = False):
+                    rng=None, train: bool = False, remat: bool = False):
     """Run stacked layers as a GPipe pipeline over ``mesh``'s ``axis``.
 
     Args:
@@ -82,7 +98,7 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     P_size = mesh.shape[axis]
     if P_size == 1:
         return scan_blocks(block_apply, stacked_params, x, rng=rng,
-                           train=train)
+                           train=train, remat=remat)
     if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
         raise NotImplementedError(
             "pipe and seq axes cannot be combined yet: ring attention nests "
@@ -99,6 +115,15 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     mb = B // M
     perm = [(i, (i + 1) % P_size) for i in range(P_size)]
 
+    apply = block_apply
+    if remat:
+        # same trade as scan_blocks: recompute each layer's forward in the
+        # backward pipeline instead of holding every microbatch activation
+        ck = jax.checkpoint(
+            lambda p, h, r, t: block_apply(p, h, rng=r, train=t),
+            static_argnums=(3,), prevent_cse=False)
+        apply = lambda p, h, rng=None, train=False: ck(p, h, rng, train)
+
     def stage_fn(params_local, h, stage, mb_id):
         def layer_body(h, scanned):
             i, p = scanned
@@ -106,7 +131,7 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             if rng is not None and train:
                 g = stage * L_local + i          # global layer index
                 r = jax.random.fold_in(jax.random.fold_in(rng, g), mb_id)
-            return block_apply(p, h, rng=r, train=train), None
+            return apply(p, h, rng=r, train=train), None
         h, _ = lax.scan(layer_body, h, (jnp.arange(L_local), params_local))
         return h
 

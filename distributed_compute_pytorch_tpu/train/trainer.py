@@ -167,6 +167,8 @@ class Trainer:
                 kw["max_seq_len"] = int(inputs.shape[1])
         if cfg.model in ("bert", "gpt2") and cfg.microbatches:
             kw["pipeline_microbatches"] = cfg.microbatches
+        if cfg.model in ("bert", "gpt2", "moe") and cfg.remat:
+            kw["remat"] = True
         if cfg.param_dtype not in (None, "float32"):
             kw["param_dtype"] = jnp.dtype(cfg.param_dtype)
         return kw
