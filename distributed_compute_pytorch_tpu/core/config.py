@@ -62,6 +62,7 @@ class Config:
     download: bool = False         # fetch missing data (coordinator + barrier)
     ckpt_path: str = "checkpoint.npz"  # reference writes 'mnist.pt' (main.py:133)
     resume: bool = False           # restore path the reference lacks (SURVEY §5.4)
+    import_torch: str | None = None  # start from a reference mnist.pt (interop.py)
 
     # --- elastic / fault tolerance (SURVEY §5.3; the reference has none) ---
     checkpoint_every: int = 0      # also checkpoint every N steps (0 = per-epoch
@@ -89,6 +90,10 @@ class Config:
     remat: bool = False              # rematerialise transformer blocks on backward
                                      # (jax.checkpoint): trades one extra forward
                                      # for ~2-4x batch when HBM binds
+    compile_cache_dir: str | None = field(
+        default_factory=lambda: _env("DCP_COMPILE_CACHE"))
+                                     # persistent XLA compile cache (skip
+                                     # recompiles across restarts/relaunches)
     profile_dir: str | None = None   # opt-in XLA profiler traces (SURVEY §5.1)
 
     # --- eval behaviour: reference evaluates on the TRAIN set (main.py:130, bug §A.1).
@@ -142,6 +147,9 @@ class Config:
                             "only, like the reference's download=True)")
         p.add_argument("--ckpt_path", type=str, default=cls.ckpt_path)
         p.add_argument("--resume", action="store_true")
+        p.add_argument("--import_torch", type=str, default=None,
+                       help="initialise from a reference torch checkpoint "
+                            "(mnist.pt); convnet only")
         p.add_argument("--checkpoint_every", type=int,
                        default=cls.checkpoint_every,
                        help="also checkpoint every N steps (0 = per-epoch "
@@ -168,6 +176,9 @@ class Config:
         p.add_argument("--remat", action="store_true",
                        help="rematerialise transformer blocks on backward "
                             "(bigger batches when HBM binds)")
+        p.add_argument("--compile_cache_dir", type=str, default=None,
+                       help="persistent XLA compile cache directory "
+                            "(env DCP_COMPILE_CACHE)")
         p.add_argument("--profile_dir", type=str, default=None)
         p.add_argument("--eval_on_train", action="store_true",
                        help="replicate reference bug §A.1 (eval on train split)")
@@ -180,7 +191,8 @@ class Config:
         kw = {f.name: getattr(ns, f.name) for f in dataclasses.fields(cls)
               if hasattr(ns, f.name)}
         # env-derived fields fall back to env when flags were not given
-        for k in ("coordinator", "num_processes", "process_id"):
+        for k in ("coordinator", "num_processes", "process_id",
+                  "compile_cache_dir"):
             if kw.get(k) is None:
                 kw[k] = getattr(base, k)
         return cls(**kw)

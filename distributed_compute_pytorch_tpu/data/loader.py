@@ -99,30 +99,47 @@ class DeviceFeeder:
     def steps_per_epoch(self) -> int:
         return self.sampler.num_batches
 
-    def epoch(self, epoch: int = 0, skip: int = 0
-              ) -> Iterator[tuple[jax.Array, jax.Array]]:
+    def epoch(self, epoch: int = 0, skip: int = 0, with_valid: bool = False
+              ) -> Iterator[tuple[jax.Array, ...]]:
         """Yield ``(inputs, targets)`` global arrays for one epoch.
 
         ``skip`` drops the first N batches of the (deterministic) epoch
         order — mid-epoch resume lands on exactly the batch the checkpoint
         interrupted, because the order is a pure function of (seed, epoch).
+
+        ``with_valid`` appends a float ``[global_batch]`` validity mask:
+        1.0 everywhere except the wraparound-padded tail rows of the final
+        batch, letting eval weight them out instead of double-counting
+        (the reference's DistributedSampler padding counts them twice).
         """
         order = self.sampler.epoch_order(epoch)
+        num_batches = len(order)
         if skip:
             order = order[skip:]
         in_shape = (self.global_batch, *self.dataset.inputs.shape[1:])
         tgt_shape = (self.global_batch, *self.dataset.targets.shape[1:])
         in_rows = _local_row_span(self.input_sharding, in_shape)
         tgt_rows = _local_row_span(self.target_sharding, tgt_shape)
+        if with_valid:
+            valid_sharding = batch_sharding(self.mesh, 1)
+            valid_rows = _local_row_span(valid_sharding, (self.global_batch,))
         from distributed_compute_pytorch_tpu import native
-        for batch_idx in order:
+        for b, batch_idx in enumerate(order, start=skip):
             # row gather is the per-step host hot loop; the C++ path skips
             # numpy fancy-indexing overhead (falls back transparently)
             x = native.gather_rows(self.dataset.inputs, batch_idx[in_rows])
             if x is None:
                 x = self.dataset.inputs[batch_idx[in_rows]]
             y = self.dataset.targets[batch_idx[tgt_rows]]
-            yield (
+            out = (
                 jax.make_array_from_process_local_data(self.input_sharding, x, in_shape),
                 jax.make_array_from_process_local_data(self.target_sharding, y, tgt_shape),
             )
+            if with_valid:
+                valid = np.ones(self.global_batch, np.float32)
+                pad = self.sampler.pad_count
+                if pad and b == num_batches - 1:
+                    valid[-pad:] = 0.0
+                out = (*out, jax.make_array_from_process_local_data(
+                    valid_sharding, valid[valid_rows], (self.global_batch,)))
+            yield out

@@ -53,6 +53,11 @@ class ShardedSampler:
     def padded_size(self) -> int:
         return self.num_batches * self.global_batch
 
+    @property
+    def pad_count(self) -> int:
+        """Wraparound-duplicated rows in the last batch (0 when drop_last)."""
+        return 0 if self.drop_last else self.padded_size - self.num_examples
+
     def epoch_order(self, epoch: int) -> np.ndarray:
         """Padded global order for ``epoch`` as ``[num_batches, global_batch]``.
 

@@ -1,0 +1,108 @@
+"""Torch-checkpoint interop: import the reference's ``mnist.pt``.
+
+The reference persists ``torch.save(model.state_dict(), "mnist.pt")``
+(``/root/reference/main.py:133``), with keys ``module.``-prefixed iff the
+model was DDP-wrapped (SURVEY §A.6 schema drift). A user switching from the
+reference to this framework can carry those checkpoints over: this module
+converts the torch state_dict of the reference ConvNet into framework
+``(params, state)``, handling the layout differences that the TPU-native
+design introduces:
+
+- conv kernels: torch OIHW -> our HWIO,
+- linear kernels: torch ``[out, in]`` -> our ``[in, out]``,
+- ``fc1`` additionally permutes its input features: torch flattens NCHW
+  (channel-major ``c,h,w``) while we flatten NHWC (``h,w,c``), so the 9216
+  columns are reordered to keep the matmul identical,
+- BatchNorm1d: ``weight/bias`` -> ``scale/bias`` params; ``running_mean/
+  running_var`` -> framework model-state (``num_batches_tracked`` dropped —
+  the framework tracks schedule state elsewhere).
+
+Equivalence (same log-probs as the torch model in eval mode) is pinned in
+``tests/test_torch_import.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+
+PyTree = Any
+
+
+def _np(t) -> np.ndarray:
+    """Accept torch tensors or arrays without importing torch here."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def strip_ddp_prefix(state_dict: Mapping[str, Any]) -> dict[str, Any]:
+    """Drop the ``module.`` prefix a DDP-wrapped save carries (SURVEY §A.6)."""
+    return {(k[len("module."):] if k.startswith("module.") else k): v
+            for k, v in state_dict.items()}
+
+
+def convnet_from_torch_state_dict(state_dict: Mapping[str, Any],
+                                  model: ConvNet | None = None
+                                  ) -> tuple[PyTree, PyTree]:
+    """Reference-ConvNet torch ``state_dict`` -> framework ``(params, state)``.
+
+    Accepts both plain and ``module.``-prefixed key schemas; values may be
+    torch tensors or numpy arrays.
+    """
+    model = model or ConvNet()
+    sd = {k: _np(v) for k, v in strip_ddp_prefix(state_dict).items()}
+    missing = [k for k in ("conv1.weight", "conv2.weight", "fc1.weight",
+                           "fc2.weight", "batchnorm.weight",
+                           "batchnorm.running_mean") if k not in sd]
+    if missing:
+        raise KeyError(f"state_dict missing reference-ConvNet keys {missing}; "
+                       f"got {sorted(sd)}")
+
+    def conv(name):
+        # OIHW -> HWIO
+        return {"kernel": jnp.asarray(sd[f"{name}.weight"].transpose(2, 3, 1, 0),
+                                      model.param_dtype),
+                "bias": jnp.asarray(sd[f"{name}.bias"], model.param_dtype)}
+
+    def dense(name):
+        return {"kernel": jnp.asarray(sd[f"{name}.weight"].T, model.param_dtype),
+                "bias": jnp.asarray(sd[f"{name}.bias"], model.param_dtype)}
+
+    # fc1's input features: torch flattened (c, h, w), we flatten (h, w, c)
+    h, w = model.image_size
+    fh, fw = (h - 4) // 2, (w - 4) // 2
+    fc1_w = sd["fc1.weight"]                      # [128, c*h*w-ordered 9216]
+    fc1_w = (fc1_w.reshape(-1, 64, fh, fw)        # [128, c, h, w]
+             .transpose(0, 2, 3, 1)               # [128, h, w, c]
+             .reshape(fc1_w.shape[0], -1))        # [128, hwc-ordered 9216]
+    fc1 = {"kernel": jnp.asarray(fc1_w.T, model.param_dtype),
+           "bias": jnp.asarray(sd["fc1.bias"], model.param_dtype)}
+
+    params = {
+        "conv1": conv("conv1"),
+        "conv2": conv("conv2"),
+        "fc1": fc1,
+        "batchnorm": {
+            "scale": jnp.asarray(sd["batchnorm.weight"], model.param_dtype),
+            "bias": jnp.asarray(sd["batchnorm.bias"], model.param_dtype),
+        },
+        "fc2": dense("fc2"),
+    }
+    state = {"batchnorm": {
+        "mean": jnp.asarray(sd["batchnorm.running_mean"], jnp.float32),
+        "var": jnp.asarray(sd["batchnorm.running_var"], jnp.float32),
+    }}
+    return params, state
+
+
+def load_reference_checkpoint(path: str, model: ConvNet | None = None
+                              ) -> tuple[PyTree, PyTree]:
+    """Load the reference's ``mnist.pt`` from disk (requires torch)."""
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return convnet_from_torch_state_dict(sd, model)

@@ -133,16 +133,12 @@ class BertMLM:
         loss = jnp.sum(per_tok * selected) / n_sel
         return loss, new_state
 
-    def eval_metrics(self, logits, tokens):
+    def eval_metrics(self, logits, tokens, valid=None):
         """Eval without masking randomness: score all positions (a stable
-        pseudo-perplexity proxy)."""
+        pseudo-perplexity proxy). ``valid`` weights whole sequences."""
         pred = jnp.argmax(logits, axis=-1)
-        return {
-            "loss_sum": L.cross_entropy_with_logits(
-                logits, tokens, "sum").astype(jnp.float32),
-            "correct": jnp.sum((pred == tokens).astype(jnp.int32)),
-            "count": jnp.asarray(tokens.size, jnp.int32),
-        }
+        per_tok = L.cross_entropy_with_logits(logits, tokens, "none")
+        return L.token_eval_metrics(per_tok, pred == tokens, valid)
 
     def partition_rules(self):
         return tp_partition_rules()

@@ -124,15 +124,15 @@ class GPT2:
         return L.cross_entropy_with_logits(logits[:, :-1], tokens[:, 1:],
                                            "sum")
 
-    def eval_metrics(self, logits, tokens):
-        """Token-level sums for eval aggregation (step.py eval protocol)."""
+    def eval_metrics(self, logits, tokens, valid=None):
+        """Token-level sums for eval aggregation (step.py eval protocol).
+
+        ``valid`` (float ``[B]``) weights whole sequences — 0.0 rows are the
+        feeder's wraparound padding and contribute nothing."""
         pred = jnp.argmax(logits[:, :-1], axis=-1)
         tgt = tokens[:, 1:]
-        return {
-            "loss_sum": self.loss_sum(logits, tokens).astype(jnp.float32),
-            "correct": jnp.sum((pred == tgt).astype(jnp.int32)),
-            "count": jnp.asarray(tgt.size, jnp.int32),
-        }
+        per_tok = L.cross_entropy_with_logits(logits[:, :-1], tgt, "none")
+        return L.token_eval_metrics(per_tok, pred == tgt, valid)
 
     def partition_rules(self):
         return tp_partition_rules()

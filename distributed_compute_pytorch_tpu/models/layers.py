@@ -262,3 +262,21 @@ def nll_loss(log_probs, targets, reduction: str = "mean"):
 def cross_entropy_with_logits(logits, targets, reduction: str = "mean"):
     """Fused log_softmax + nll for the transformer rungs."""
     return nll_loss(jax.nn.log_softmax(logits, -1), targets, reduction)
+
+
+def token_eval_metrics(per_tok_loss, correct, valid=None):
+    """Weighted token-level eval sums shared by the LM models.
+
+    ``per_tok_loss``/``correct``: float ``[B, T']`` per-token values.
+    ``valid``: optional float ``[B]`` sequence mask — 0.0 rows are the
+    feeder's wraparound padding and contribute nothing (exact eval).
+    """
+    per_tok_loss = per_tok_loss.astype(jnp.float32)
+    w = (jnp.ones_like(per_tok_loss) if valid is None
+         else jnp.broadcast_to(valid[:, None].astype(jnp.float32),
+                               per_tok_loss.shape))
+    return {
+        "loss_sum": jnp.sum(per_tok_loss * w),
+        "correct": jnp.sum(correct.astype(jnp.float32) * w).astype(jnp.int32),
+        "count": jnp.sum(w).astype(jnp.int32),
+    }

@@ -222,10 +222,10 @@ class MoETransformerLM:
         x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"],
                                                          jnp.arange(T))
         L_n = c.num_layers
-        # prevent_cse=False: safe under scan-over-layers (see scan_blocks)
-        block_apply = (jax.checkpoint(self._block_apply, static_argnums=(3,),
-                                      prevent_cse=False)
-                       if c.remat else self._block_apply)
+        from distributed_compute_pytorch_tpu.parallel.pipeline import (
+            remat_wrap)
+        block_apply = (remat_wrap(self._block_apply) if c.remat
+                       else self._block_apply)
 
         def body(carry, scanned):
             h, lb, z = carry
@@ -256,16 +256,12 @@ class MoETransformerLM:
         loss = ce + c.lb_weight * aux["lb_loss"] + c.z_weight * aux["z_loss"]
         return loss, new_state
 
-    def eval_metrics(self, out, tokens):
+    def eval_metrics(self, out, tokens, valid=None):
         logits, _ = out
         pred = jnp.argmax(logits[:, :-1], axis=-1)
         tgt = tokens[:, 1:]
-        return {
-            "loss_sum": L.cross_entropy_with_logits(
-                logits[:, :-1], tgt, "sum").astype(jnp.float32),
-            "correct": jnp.sum((pred == tgt).astype(jnp.int32)),
-            "count": jnp.asarray(tgt.size, jnp.int32),
-        }
+        per_tok = L.cross_entropy_with_logits(logits[:, :-1], tgt, "none")
+        return L.token_eval_metrics(per_tok, pred == tgt, valid)
 
     def partition_rules(self):
         """Expert weights: layer dim (stacked) + expert dim over ``expert``;

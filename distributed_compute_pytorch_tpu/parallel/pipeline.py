@@ -33,6 +33,19 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def remat_wrap(block_apply):
+    """``jax.checkpoint`` around one block: recompute its forward in the
+    backward pass instead of saving intermediates — ~2-4x batch for one
+    extra forward when HBM binds. ``prevent_cse=False`` because
+    scan-over-layers already rules out the unsound CSE the checkpoint
+    barriers guard against, and the barriers would block fusion on exactly
+    the HBM-bound runs that turn remat on."""
+    ck = jax.checkpoint(
+        lambda p, h, r, t: block_apply(p, h, rng=r, train=t),
+        static_argnums=(3,), prevent_cse=False)
+    return lambda p, h, rng=None, train=False: ck(p, h, rng, train)
+
+
 def stacked_layers(layer_params: list):
     """Stack per-layer pytrees (identical structure) into one pytree with a
     leading ``[L, ...]`` dim — the storage format both ``scan_blocks`` and
@@ -58,16 +71,7 @@ def scan_blocks(block_apply, stacked_params, x, *, rng=None,
     not FLOPs, binds.
     """
     L = num_layers(stacked_params)
-    apply = block_apply
-    if remat:
-        # prevent_cse=False: scan-over-layers already rules out the unsound
-        # CSE that checkpoint's optimization barriers guard against, and the
-        # barriers would block fusion on exactly the HBM-bound runs that
-        # turn remat on
-        ck = jax.checkpoint(
-            lambda p, h, r, t: block_apply(p, h, rng=r, train=t),
-            static_argnums=(3,), prevent_cse=False)
-        apply = lambda p, h, rng=None, train=False: ck(p, h, rng, train)
+    apply = remat_wrap(block_apply) if remat else block_apply
 
     def body(h, scanned):
         i, p = scanned
@@ -115,14 +119,7 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     mb = B // M
     perm = [(i, (i + 1) % P_size) for i in range(P_size)]
 
-    apply = block_apply
-    if remat:
-        # same trade as scan_blocks: recompute each layer's forward in the
-        # backward pipeline instead of holding every microbatch activation
-        ck = jax.checkpoint(
-            lambda p, h, r, t: block_apply(p, h, rng=r, train=t),
-            static_argnums=(3,), prevent_cse=False)
-        apply = lambda p, h, rng=None, train=False: ck(p, h, rng, train)
+    apply = remat_wrap(block_apply) if remat else block_apply
 
     def stage_fn(params_local, h, stage, mb_id):
         def layer_body(h, scanned):

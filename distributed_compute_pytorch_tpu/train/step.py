@@ -159,7 +159,7 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         return new_state, metrics
 
     @jax.jit
-    def eval_step(state: TrainState, x, y, acc=None):
+    def eval_step(state: TrainState, x, y, acc=None, valid=None):
         """Eval-batch metrics == reference ``test`` body (``main.py:78-86``).
 
         Returns device-side sums; the cross-replica ``all_reduce(SUM)`` of
@@ -173,13 +173,17 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         batches dispatched async can otherwise run concurrently and deadlock
         the CPU backend's in-process rendezvous (XLA CPU collectives assume
         one program at a time over the faked device set).
+
+        ``valid``: optional float ``[batch]`` mask weighting each example's
+        contribution (0.0 for the feeder's wraparound-padded rows), making
+        eval exact where the reference double-counts padding.
         """
         with use_mesh(mesh):
             out, _ = model.apply(_cast_params(state.params),
                                  state.model_state, _cast(x), train=False)
         if hasattr(model, "eval_metrics"):
-            metrics = model.eval_metrics(out, y)
-        else:
+            metrics = model.eval_metrics(out, y, valid=valid)
+        elif valid is None:
             loss_sum = model.loss_sum(out, y) if hasattr(model, "loss_sum") \
                 else model.loss_fn(out, y) * x.shape[0]
             pred = jnp.argmax(out, axis=-1)
@@ -187,6 +191,19 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
             metrics = {"loss_sum": loss_sum.astype(jnp.float32),
                        "correct": correct,
                        "count": jnp.asarray(x.shape[0], jnp.int32)}
+        else:
+            # generic classifier path ([B, C] outputs): per-example NLL so
+            # the mask can weight it. log_softmax first — correct for raw
+            # logits (resnet) and idempotent on log-probs (convnet)
+            log_probs = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+            per_ex = -jnp.take_along_axis(log_probs, y[:, None], axis=-1)[:, 0]
+            pred = jnp.argmax(out, axis=-1)
+            metrics = {
+                "loss_sum": jnp.sum(per_ex * valid),
+                "correct": jnp.sum(((pred == y).astype(jnp.float32)
+                                    * valid)).astype(jnp.int32),
+                "count": jnp.sum(valid).astype(jnp.int32),
+            }
         if acc is not None:
             metrics = jax.tree.map(jnp.add, metrics, acc)
         return metrics

@@ -47,6 +47,10 @@ class Trainer:
         self.config = config
         initialize_distributed(config.coordinator, config.num_processes,
                                config.process_id)
+        if config.compile_cache_dir:
+            from distributed_compute_pytorch_tpu.utils.compilation_cache import (
+                enable as enable_compile_cache)
+            enable_compile_cache(config.compile_cache_dir)
         if config.force_cpu:
             # fixed --no-cuda (reference main.py:142, SURVEY §A.7): an actual
             # boolean that pins the run to host CPU devices. config.update
@@ -116,6 +120,26 @@ class Trainer:
                 self.start_epoch = epoch + 1
                 log0(f"resumed from {config.ckpt_path} at epoch "
                      f"{self.start_epoch}")
+        if config.import_torch and self._resumed:
+            # a restart (supervisor or manual --resume) must keep the
+            # restored progress, not reset to the imported weights
+            log0(f"resume checkpoint found; skipping --import_torch "
+                 f"{config.import_torch}")
+        elif config.import_torch:
+            # migration path for reference users: start from their mnist.pt
+            # (main.py:133) instead of a fresh init
+            from distributed_compute_pytorch_tpu import interop
+            if config.model != "convnet":
+                raise ValueError("--import_torch supports the reference "
+                                 "ConvNet checkpoint schema (model=convnet)")
+            params, mstate = interop.load_reference_checkpoint(
+                config.import_torch, self.model)
+            params = jax.tree.map(lambda p, a: jax.device_put(p, a.sharding),
+                                  params, self.state.params)
+            mstate = jax.tree.map(lambda p, a: jax.device_put(p, a.sharding),
+                                  mstate, self.state.model_state)
+            self.state = self.state.replace(params=params, model_state=mstate)
+            log0(f"imported torch checkpoint {config.import_torch}")
         self.heartbeat = (Heartbeat(config.heartbeat_path)
                           if config.heartbeat_path else None)
 
@@ -233,8 +257,9 @@ class Trainer:
 
     def evaluate(self, epoch: int) -> dict:
         """Full eval pass == reference ``test`` (``main.py:70-95``), with the
-        loss math fixed (§A.5) and padding double-counts accepted exactly as
-        the reference's DistributedSampler padding does.
+        loss math fixed (§A.5) and — unlike the reference's
+        DistributedSampler padding, which double-counts wraparound rows —
+        exact: the feeder marks padded rows and eval weights them out.
 
         Metrics accumulate *on device*, threaded through ``eval_step`` as a
         carry; the host fetches once at the end instead of blocking on three
@@ -250,16 +275,18 @@ class Trainer:
         the async pipeline is kept there."""
         serialize = self.mesh.devices.flat[0].platform == "cpu"
         dev_total = None
-        for b, (x, y) in enumerate(self.eval_feed.epoch(0)):
+        for b, (x, y, valid) in enumerate(
+                self.eval_feed.epoch(0, with_valid=True)):
             if self.heartbeat is not None and b % self.config.log_every == 0:
                 self.heartbeat.beat(epoch, b)   # stay live through eval
             if dev_total is None:
                 # zero-seed the carry so every batch hits the same compiled
                 # program (an acc=None first call would compile eval twice)
-                shapes = jax.eval_shape(self.eval_step, self.state, x, y)
+                shapes = jax.eval_shape(self.eval_step, self.state, x, y,
+                                        None, valid)
                 dev_total = jax.tree.map(
                     lambda s: jnp.zeros(s.shape, s.dtype), shapes)
-            dev_total = self.eval_step(self.state, x, y, dev_total)
+            dev_total = self.eval_step(self.state, x, y, dev_total, valid)
             if serialize:
                 jax.block_until_ready(dev_total)
         total = ({"loss_sum": 0.0, "correct": 0, "count": 0}
