@@ -58,6 +58,8 @@ class Config:
 
     # --- data / checkpoint paths ---
     data_dir: str = "./data"       # reference uses './data/' (main.py:107)
+    prefetch: int = 2              # feeder prefetch depth (0 = synchronous);
+                                   # the DataLoader-workers role (main.py:110)
     require_real_data: bool = False  # error (not warn) if real data missing
     download: bool = False         # fetch missing data (coordinator + barrier)
     ckpt_path: str = "checkpoint.npz"  # reference writes 'mnist.pt' (main.py:133)
@@ -140,6 +142,8 @@ class Config:
         p.add_argument("--log_every", type=int, default=cls.log_every)
         p.add_argument("--seed", type=int, default=cls.seed)
         p.add_argument("--data_dir", type=str, default=cls.data_dir)
+        p.add_argument("--prefetch", type=int, default=cls.prefetch,
+                       help="feeder prefetch depth (0 = synchronous)")
         p.add_argument("--require_real_data", action="store_true",
                        help="fail instead of substituting synthetic data")
         p.add_argument("--download", action="store_true",

@@ -11,6 +11,8 @@ only touches its own shard — no cross-host data traffic.
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Iterator
 
 import jax
@@ -53,19 +55,71 @@ def _local_row_span(sharding: NamedSharding, global_shape: tuple[int, ...]) -> s
     return slice(lo, hi)
 
 
+_SENTINEL = object()
+
+
+def _prefetched(gen: Iterator, depth: int) -> Iterator:
+    """Run ``gen`` in a daemon thread, keeping ``depth`` items ready.
+
+    Overlaps host batch assembly + device transfer with the consumer's
+    compute — the role DataLoader's worker processes play for the reference
+    (``main.py:110``), done with a thread here because the assembly is
+    numpy/C++ slicing that releases the GIL. Exceptions propagate to the
+    consumer; abandoning the iterator (break / preemption) stops the
+    producer promptly via the stop event.
+    """
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in gen:
+                if not _put(item):
+                    return
+            _put(_SENTINEL)
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            _put(e)
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="dcp-feeder-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 class DeviceFeeder:
     """Iterates epochs of globally-sharded device batches.
 
     One instance replaces the reference's dataset+sampler+loader triple
     (``main.py:107-116``): deterministic epoch-keyed order (fixing SURVEY
-    §A.9), wraparound padding, device placement with the right sharding.
+    §A.9), wraparound padding, device placement with the right sharding,
+    and background prefetch (``prefetch`` batches deep; 0 disables).
     """
 
     def __init__(self, dataset: ArrayDataset, mesh: Mesh, global_batch: int,
-                 shuffle: bool = True, seed: int = 0, drop_last: bool = False):
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = False,
+                 prefetch: int = 2):
         self.dataset = dataset
         self.mesh = mesh
         self.global_batch = global_batch
+        self.prefetch = prefetch
         local_batch_size(global_batch, mesh)  # raises clearly if not divisible
         self.sampler = ShardedSampler(
             num_examples=len(dataset), global_batch=global_batch,
@@ -112,6 +166,11 @@ class DeviceFeeder:
         batch, letting eval weight them out instead of double-counting
         (the reference's DistributedSampler padding counts them twice).
         """
+        it = self._epoch_batches(epoch, skip, with_valid)
+        return _prefetched(it, self.prefetch) if self.prefetch else it
+
+    def _epoch_batches(self, epoch: int, skip: int, with_valid: bool
+                       ) -> Iterator[tuple[jax.Array, ...]]:
         order = self.sampler.epoch_order(epoch)
         num_batches = len(order)
         if skip:

@@ -75,10 +75,12 @@ class Trainer:
 
         self.train_feed = DeviceFeeder(self.train_data, self.mesh,
                                        config.batch_size, shuffle=True,
-                                       seed=config.seed)
+                                       seed=config.seed,
+                                       prefetch=config.prefetch)
         self.eval_feed = DeviceFeeder(self.eval_data, self.mesh,
                                       config.batch_size, shuffle=False,
-                                      seed=config.seed)
+                                      seed=config.seed,
+                                      prefetch=config.prefetch)
 
         self.model = model if model is not None else build_model(
             config.model, **self._model_kwargs())

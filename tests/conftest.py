@@ -23,6 +23,15 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
+# persistent compile cache: repeat suite runs skip XLA compilation entirely
+# (keyed by HLO hash + jaxlib version, so it can't serve stale programs)
+from distributed_compute_pytorch_tpu.utils.compilation_cache import (  # noqa: E402
+    enable as _enable_compile_cache)
+
+_enable_compile_cache(os.environ.get(
+    "DCP_COMPILE_CACHE",
+    os.path.join(os.path.dirname(__file__), ".jax_cache")))
+
 # Environments that preload jax at interpreter startup (e.g. a TPU-plugin
 # sitecustomize) have already latched JAX_PLATFORMS from their own env; the
 # config update below wins as long as no backend has initialised yet.

@@ -151,6 +151,9 @@ def _env():
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)     # 1 CPU device is enough and fastest
+    # share the suite's compile cache: each child process skips XLA compiles
+    env.setdefault("DCP_COMPILE_CACHE",
+                   os.path.join(os.path.dirname(__file__), ".jax_cache"))
     return env
 
 

@@ -1,0 +1,63 @@
+"""Feeder prefetch: a background thread keeps batches ready (the role of
+the reference DataLoader's workers, ``main.py:110``) without changing
+order, values, exceptions, or early-exit behaviour."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+from distributed_compute_pytorch_tpu.data.datasets import synthetic_images
+from distributed_compute_pytorch_tpu.data.loader import (
+    DeviceFeeder, _prefetched)
+
+
+def test_prefetched_preserves_order_and_values():
+    got = list(_prefetched(iter(range(100)), depth=3))
+    assert got == list(range(100))
+
+
+def test_prefetched_propagates_exceptions():
+    def gen():
+        yield 1
+        raise ValueError("boom")
+    it = _prefetched(gen(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="boom"):
+        next(it)
+
+
+def test_prefetched_stops_producer_on_abandon():
+    started = threading.Event()
+    produced = []
+
+    def gen():
+        for i in range(10_000):
+            started.set()
+            produced.append(i)
+            yield i
+
+    it = _prefetched(gen(), depth=2)
+    next(it)
+    started.wait(5)
+    it.close()                    # consumer walks away (break / preemption)
+    time.sleep(0.5)               # producer must notice the stop event
+    n = len(produced)
+    time.sleep(0.3)
+    assert len(produced) == n     # no further production
+    assert n < 100                # and it never ran ahead of the depth
+
+
+def test_feeder_prefetch_matches_synchronous(devices8):
+    mesh = make_mesh("data=8", devices=devices8)
+    data = synthetic_images(96, (28, 28, 1), 10, seed=2)
+    sync = DeviceFeeder(data, mesh, 32, shuffle=True, seed=5, prefetch=0)
+    pre = DeviceFeeder(data, mesh, 32, shuffle=True, seed=5, prefetch=2)
+    a = [(np.asarray(x), np.asarray(y)) for x, y in sync.epoch(3)]
+    b = [(np.asarray(x), np.asarray(y)) for x, y in pre.epoch(3)]
+    assert len(a) == len(b) == 3
+    for (xa, ya), (xb, yb) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
