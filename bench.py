@@ -119,7 +119,7 @@ def _bench_gpt2(jax, jnp, np, mesh, n_chips, peak_flops):
     np.asarray(m["loss"])
     dt = (time.perf_counter() - t0) / iters
     tokens_per_sec = B * T / dt
-    n_params = 124e6
+    n_params = sum(leaf.size for leaf in jax.tree.leaves(state.params))
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * T * cfg.d_model
     mfu = (tokens_per_sec * flops_per_token / (peak_flops * n_chips)
            if peak_flops else None)
@@ -223,8 +223,9 @@ def _bench_bert(jax, jnp, np, mesh, n_chips, peak_flops):
     # MFU from the same analytic convention as the GPT-2 stage (6N fwd+bwd
     # + attention term). XLA's cost analysis undercounts here — the Pallas
     # attention custom call is opaque to it — so it is reported for
-    # reference, not used for MFU.
-    n_params = 110e6
+    # reference, not used for MFU. N is the actual parameter count so the
+    # number tracks BertConfig instead of a hardcoded 110e6.
+    n_params = sum(leaf.size for leaf in jax.tree.leaves(state.params))
     flops = (6 * n_params + 12 * cfg.num_layers * T * cfg.d_model) * B * T
     mfu = flops / dt / (peak_flops * n_chips) if peak_flops else None
     return {

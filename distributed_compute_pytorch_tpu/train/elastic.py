@@ -80,6 +80,11 @@ class PreemptionGuard:
     def _handler(self, signum, frame):
         if self.preempted:  # second signal: behave like the original handler
             prev = self._previous.get(signum)
+            if prev is signal.SIG_IGN:
+                # the signal was ignored before we latched it; restoring and
+                # re-raising would turn "ignored" into process death
+                signal.signal(signum, signal.SIG_IGN)
+                return
             signal.signal(signum, prev if callable(prev) else signal.SIG_DFL)
             os.kill(os.getpid(), signum)
             return
@@ -201,9 +206,13 @@ def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,
             attempt += 1
             if (rc == 0 and not hung) or stopping["flag"]:
                 return rc
-            if rc == EXIT_PREEMPTED:
+            if rc == EXIT_PREEMPTED and not hung:
                 # clean preemption: checkpointed, transient by definition —
-                # restarting it must not consume the failure budget
+                # restarting it must not consume the failure budget. A child
+                # we hang-killed still counts as a failure even if its
+                # PreemptionGuard managed to checkpoint on the way out —
+                # otherwise a too-short heartbeat_timeout kill-restarts
+                # forever without ever consuming max_restarts.
                 print(f"[supervise] child preempted (exit {rc}); "
                       f"restarting with --resume", file=sys.stderr, flush=True)
                 continue

@@ -100,6 +100,7 @@ class Trainer:
         self.state = self.init_fn(jax.random.key(config.seed))
         self.start_epoch = 0
         self.start_step = 0            # step within start_epoch (mid-epoch resume)
+        self._pending_eval_epoch = None  # epoch trained but not yet evaluated
         self._resumed = False
         if config.resume and os.path.exists(config.ckpt_path):
             manifest = checkpoint.load_manifest(config.ckpt_path)
@@ -120,6 +121,11 @@ class Trainer:
                      f"step {step_in_epoch}")
             else:
                 self.start_epoch = epoch + 1
+                if not manifest.get("extra", {}).get("eval_done", True):
+                    # preempted during this epoch's eval: training is
+                    # complete but the metrics were never reported —
+                    # fit() backfills the eval before continuing
+                    self._pending_eval_epoch = epoch
                 log0(f"resumed from {config.ckpt_path} at epoch "
                      f"{self.start_epoch}")
         if config.import_torch and self._resumed:
@@ -193,8 +199,12 @@ class Trainer:
                 kw["max_seq_len"] = int(inputs.shape[1])
         if cfg.model in ("bert", "gpt2") and cfg.microbatches:
             kw["pipeline_microbatches"] = cfg.microbatches
-        if cfg.model in ("bert", "gpt2", "moe") and cfg.remat:
-            kw["remat"] = True
+        if cfg.remat:
+            if cfg.model in ("bert", "gpt2", "moe"):
+                kw["remat"] = True
+            else:
+                log0(f"WARNING: --remat is not supported by model "
+                     f"{cfg.model!r} and will be ignored")
         if cfg.param_dtype not in (None, "float32"):
             kw["param_dtype"] = jnp.dtype(cfg.param_dtype)
         return kw
@@ -257,7 +267,8 @@ class Trainer:
             raise RuntimeError(
                 f"injected fault at step {global_step} (--fault_at_step)")
 
-    def evaluate(self, epoch: int) -> dict:
+    def evaluate(self, epoch: int,
+                 guard: PreemptionGuard | None = None) -> dict:
         """Full eval pass == reference ``test`` (``main.py:70-95``), with the
         loss math fixed (§A.5) and — unlike the reference's
         DistributedSampler padding, which double-counts wraparound rows —
@@ -281,6 +292,17 @@ class Trainer:
                 self.eval_feed.epoch(0, with_valid=True)):
             if self.heartbeat is not None and b % self.config.log_every == 0:
                 self.heartbeat.beat(epoch, b)   # stay live through eval
+            if guard is not None and guard.preempted:
+                # train state is unchanged during eval, so checkpointing the
+                # finished epoch now (rather than after the full eval pass +
+                # epoch save) keeps us inside a short preemption grace
+                # window; eval_done=False makes the resume backfill the
+                # interrupted eval so its metrics line is never lost
+                checkpoint.save(self.config.ckpt_path, self.state,
+                                epoch=epoch, extra={"eval_done": False})
+                log0(f"preempted during epoch {epoch} eval; checkpoint "
+                     f"written to {self.config.ckpt_path}")
+                raise Preempted()
             if dev_total is None:
                 # zero-seed the carry so every batch hits the same compiled
                 # program (an acc=None first call would compile eval twice)
@@ -314,23 +336,40 @@ class Trainer:
         # would arm the supervisor's staleness timer and a long XLA compile
         # would then read as a hang
         with maybe_profile(cfg.profile_dir), PreemptionGuard() as guard:
+            if self._pending_eval_epoch is not None:
+                # previous incarnation was preempted during this epoch's
+                # eval (manifest eval_done=False): report its metrics now,
+                # then mark the checkpoint evaluated so another bounce
+                # doesn't repeat the pass
+                pending = self._pending_eval_epoch
+                try:
+                    last_eval = self.evaluate(pending, guard=guard)
+                except Preempted:
+                    self.logger.close()
+                    return {"preempted": True, "epoch": pending}
+                checkpoint.save(cfg.ckpt_path, self.state, epoch=pending,
+                                extra={"eval_done": True})
+                self._pending_eval_epoch = None
             for epoch in range(self.start_epoch, cfg.epochs):
                 skip = self.start_step if epoch == self.start_epoch else 0
                 timer = Timer()
                 try:
                     throughput = self.train_epoch(epoch, skip=skip,
                                                   guard=guard)
+                    last_eval = self.evaluate(epoch, guard=guard)
                 except Preempted:
                     self.logger.close()
                     return {"preempted": True, "epoch": epoch}
-                last_eval = self.evaluate(epoch)
                 self.logger.epoch_time(epoch, timer.elapsed(), throughput)
-                checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch)
+                checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
+                                extra={"eval_done": True})
                 if guard.preempted:
-                    # signal arrived during eval/save: the epoch checkpoint
-                    # just written is the resume point — exit now rather
-                    # than starting another epoch
-                    log0(f"preempted during epoch {epoch} eval; epoch "
+                    # signal arrived after eval (eval-time signals raise
+                    # Preempted inside evaluate()): during the epoch-time
+                    # print or the epoch-end save. The checkpoint just
+                    # written is the resume point — exit now rather than
+                    # starting another epoch.
+                    log0(f"preempted during epoch {epoch} epoch-end save; "
                          f"checkpoint written to {cfg.ckpt_path}")
                     self.logger.close()
                     return {"preempted": True, "epoch": epoch}

@@ -44,6 +44,20 @@ def test_heartbeat_roundtrip(tmp_path):
     assert Heartbeat.age(str(tmp_path / "missing.json")) is None
 
 
+def test_preemption_guard_second_signal_respects_sig_ign():
+    """If the signal was ignored before the guard latched it, a second
+    delivery must stay ignored — not be promoted to SIG_DFL process death."""
+    prev = signal.signal(signal.SIGUSR1, signal.SIG_IGN)
+    try:
+        with PreemptionGuard(signals=(signal.SIGUSR1,)) as guard:
+            os.kill(os.getpid(), signal.SIGUSR1)   # first: latches
+            assert guard.preempted
+            os.kill(os.getpid(), signal.SIGUSR1)   # second: must not kill us
+            assert signal.getsignal(signal.SIGUSR1) is signal.SIG_IGN
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
 def test_preemption_guard_latches_signal():
     with PreemptionGuard(signals=(signal.SIGUSR1,)) as guard:
         assert not guard.preempted
@@ -133,6 +147,42 @@ def test_preemption_checkpoints_and_resumes(tmp_path, devices8):
     for a, b in zip(jax.tree_util.tree_leaves(ref.state.params),
                     jax.tree_util.tree_leaves(t2.state.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_preemption_during_eval_checkpoints_and_backfills(tmp_path, devices8):
+    """A SIGTERM during the eval pass checkpoints immediately (eval_done
+    False) instead of finishing the pass; the resumed run backfills the
+    missing eval metrics, then marks the checkpoint evaluated."""
+    data = _data()
+    cfg = _mk_config(tmp_path)
+    t1 = Trainer(cfg, train_data=data, eval_data=data)
+
+    real_eval_step = t1.eval_step
+    calls = {"n": 0}
+
+    def eval_then_signal(state, x, y, acc, valid):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return real_eval_step(state, x, y, acc, valid)
+
+    t1.eval_step = eval_then_signal
+    result = t1.fit()
+    assert result == {"preempted": True, "epoch": 0}
+    from distributed_compute_pytorch_tpu.train.checkpoint import load_manifest
+    man = load_manifest(cfg.ckpt_path)
+    assert man["epoch"] == 0
+    assert man["extra"]["eval_done"] is False
+    assert "step_in_epoch" not in man["extra"]
+
+    t2 = Trainer(cfg.replace(resume=True), train_data=data, eval_data=data)
+    assert t2.start_epoch == 1 and t2._pending_eval_epoch == 0
+    out = t2.fit()                 # epochs=1 -> only the backfilled eval runs
+    assert "accuracy" in out
+    assert load_manifest(cfg.ckpt_path)["extra"]["eval_done"] is True
+    # a further resume must not repeat the eval pass
+    t3 = Trainer(cfg.replace(resume=True), train_data=data, eval_data=data)
+    assert t3._pending_eval_epoch is None
 
 
 # --------------------------------------------------------- supervisor (CLI)
@@ -238,6 +288,28 @@ def test_supervise_preemptions_do_not_consume_restart_budget(tmp_path):
         "sys.exit(75 if int(os.environ['DCP_RESTART_COUNT']) < 2 else 0)\n")
     rc = supervise([str(script)], max_restarts=0, poll_interval=0.05)
     assert rc == 0
+
+
+def test_supervise_hang_kill_consumes_budget_even_if_preempt_exit(tmp_path):
+    """A hang-killed child that manages to exit EXIT_PREEMPTED (its guard
+    checkpointed on the way out) still counts as a failure — otherwise a
+    too-short heartbeat_timeout kill-restarts forever for free."""
+    hb = tmp_path / "hb.json"
+    script = tmp_path / "hang_then_preempt.py"
+    script.write_text(
+        "import json, os, signal, sys, time\n"
+        f"hb = {str(hb)!r}\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))\n"
+        "json.dump({'ts': time.time(), 'epoch': 0, 'step': 0},"
+        " open(hb, 'w'))\n"
+        "time.sleep(300)\n")
+    t0 = time.time()
+    rc = supervise([str(script)], max_restarts=0, heartbeat_path=str(hb),
+                   heartbeat_timeout=1.0, poll_interval=0.05, kill_grace=5.0)
+    # budget 0 + one hang => give up after the first kill, well before any
+    # free-restart loop could spin
+    assert rc == 75
+    assert time.time() - t0 < 60
 
 
 def test_supervise_passes_restart_count(tmp_path):
