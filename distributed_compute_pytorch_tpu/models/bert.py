@@ -41,6 +41,7 @@ class BertConfig:
     # GPipe microbatch count under a pipe axis (None = pipe size)
     pipeline_microbatches: int | None = None
     remat: bool = False            # rematerialise blocks on backward
+    unroll_layers: bool = True     # python-loop blocks (see GPT2Config)
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -100,7 +101,8 @@ class BertMLM:
                                 rng=layers_rng, train=train, remat=c.remat)
         else:
             x = scan_blocks(block.apply, params["blocks"], x, remat=c.remat,
-                            rng=layers_rng, train=train)
+                            rng=layers_rng, train=train,
+                            unroll=c.unroll_layers)
         h = L.Dense(c.d_model, c.d_model).apply(params["mlm_dense"], x)
         h = jax.nn.gelu(h)
         h = L.LayerNorm(c.d_model).apply(params["mlm_ln"], h)

@@ -33,6 +33,20 @@ DEFAULT_BLOCK = 128
 _NEG_INF = -1e30
 
 
+def _dot_tt(a, b):
+    """``a @ b.T`` via dot_general contracting the trailing dims — the MXU
+    contracts either operand's layout natively; an explicit ``b.T`` inside a
+    kernel costs a VPU relayout per grid step."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _dot_nt(a, b):
+    """``a.T @ b`` via dot_general contracting the leading dims."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -56,10 +70,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)              # [Bq, D]
-        k = k_ref[0].astype(jnp.float32)              # [Bk, D]
-        v = v_ref[0].astype(jnp.float32)              # [Bk, D]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        # matmul inputs stay in their native dtype (bf16 in the mixed-
+        # precision path): the MXU multiplies bf16 natively with f32
+        # accumulation via preferred_element_type — pre-casting to f32
+        # forces multi-pass f32 matmuls at a fraction of peak
+        q = q_ref[0]                                  # [Bq, D]
+        k = k_ref[0]                                  # [Bk, D]
+        v = v_ref[0]                                  # [Bk, D]
+        s = _dot_tt(q, k) * scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -72,7 +90,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, -1, keepdims=True)
-        acc[:] = acc[:] * corr + jnp.dot(p, v,
+        acc[:] = acc[:] * corr + jnp.dot(p.astype(v.dtype), v,
                                          preferred_element_type=jnp.float32)
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
@@ -112,6 +130,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        # batch*heads and q blocks are independent — declaring them parallel
+        # lets Mosaic pipeline (double-buffer) block loads across grid steps;
+        # only the kv axis carries the accumulator dependency
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
     )(q, k, v)
     return o, lse
@@ -134,13 +157,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                  # native dtype: MXU-native bf16 matmul
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]                       # [Bq, 1]
         delta = delta_ref[0][:, :1]                   # [Bq, 1]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = _dot_tt(q, k) * scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -148,10 +171,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dp = _dot_tt(do, v)
         ds = p * (dp - delta)
         dq_acc[:] = dq_acc[:] + jnp.dot(
-            ds, k, preferred_element_type=jnp.float32) * scale
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32) * scale
 
     @pl.when(ki == last_k)
     def _write():
@@ -173,13 +196,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                  # native dtype: MXU-native bf16 matmul
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = _dot_tt(q, k) * scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -187,12 +210,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse)                 # [Bq, Bk]
-        dv_acc[:] = dv_acc[:] + jnp.dot(
-            p.T, do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dv_acc[:] = dv_acc[:] + _dot_nt(p.astype(do.dtype), do)
+        dp = _dot_tt(do, v)
         ds = p * (dp - delta)
-        dk_acc[:] = dk_acc[:] + jnp.dot(
-            ds.T, q, preferred_element_type=jnp.float32) * scale
+        dk_acc[:] = dk_acc[:] + _dot_nt(ds.astype(q.dtype), q) * scale
 
     @pl.when(qi == last_q)
     def _write():
@@ -226,6 +247,8 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
     )(q, k, v, g.astype(q.dtype), lse, delta)
 
@@ -251,6 +274,8 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
     )(q, k, v, g.astype(q.dtype), lse, delta)
     return dq, dk, dv

@@ -121,10 +121,13 @@ class Trainer:
                      f"step {step_in_epoch}")
             else:
                 self.start_epoch = epoch + 1
-                if not manifest.get("extra", {}).get("eval_done", True):
-                    # preempted during this epoch's eval: training is
-                    # complete but the metrics were never reported —
-                    # fit() backfills the eval before continuing
+                extra = manifest.get("extra", {})
+                if (not extra.get("eval_done", True)
+                        or step_in_epoch >= self.train_feed.steps_per_epoch):
+                    # eval never ran for this epoch: either preempted during
+                    # the eval pass (eval_done False) or preempted on the
+                    # epoch's last training step (step_in_epoch == steps).
+                    # fit() backfills the eval before continuing.
                     self._pending_eval_epoch = epoch
                 log0(f"resumed from {config.ckpt_path} at epoch "
                      f"{self.start_epoch}")

@@ -185,6 +185,36 @@ def test_preemption_during_eval_checkpoints_and_backfills(tmp_path, devices8):
     assert t3._pending_eval_epoch is None
 
 
+def test_preemption_on_last_train_step_backfills_eval(tmp_path, devices8):
+    """SIGTERM landing on the epoch's final training step saves
+    step_in_epoch == steps_per_epoch; the resume must recognise the epoch's
+    training as complete but its eval as missing, and backfill it."""
+    data = _data()
+    cfg = _mk_config(tmp_path)
+    t1 = Trainer(cfg, train_data=data, eval_data=data)
+    steps = t1.train_feed.steps_per_epoch
+
+    real_step = t1.train_step
+    calls = {"n": 0}
+
+    def step_then_signal(state, x, y):
+        out = real_step(state, x, y)
+        calls["n"] += 1
+        if calls["n"] == steps:          # the epoch's last step
+            os.kill(os.getpid(), signal.SIGTERM)
+        return out
+
+    t1.train_step = step_then_signal
+    assert t1.fit() == {"preempted": True, "epoch": 0}
+    from distributed_compute_pytorch_tpu.train.checkpoint import load_manifest
+    assert load_manifest(cfg.ckpt_path)["extra"]["step_in_epoch"] == steps
+
+    t2 = Trainer(cfg.replace(resume=True), train_data=data, eval_data=data)
+    assert t2.start_epoch == 1 and t2._pending_eval_epoch == 0
+    out = t2.fit()
+    assert "accuracy" in out
+
+
 # --------------------------------------------------------- supervisor (CLI)
 
 
