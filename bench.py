@@ -228,6 +228,41 @@ def _bench_resnet18(jax, jnp, np, mesh, n_chips, peak_flops):
     }
 
 
+def _bench_resnet50(jax, jnp, np, mesh, n_chips, peak_flops):
+    """BASELINE.md rung 2 (configs[2]): ResNet-50 at ImageNet geometry
+    (224x224x3), bf16 train step, samples/sec/chip + MFU from XLA's own
+    FLOP count. The input pipeline half of this rung is the streaming
+    sharded dataset (data/shards.py), exercised in tests; this stage pins
+    the compute half on real hardware."""
+    from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
+    from distributed_compute_pytorch_tpu.models.resnet import ResNet
+    from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+    from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+    B = 128 * n_chips    # measured best on v5e (0.29 vs 0.28 at 64/256)
+    model = ResNet.build("resnet50", num_classes=1000, in_channels=3)
+    tx = build_optimizer("sgd", lr=0.1, gamma=0.97, steps_per_epoch=100)
+    init_fn, train_step, _ = make_step_fns(model, tx, mesh,
+                                           compute_dtype=jnp.bfloat16)
+    state = init_fn(jax.random.key(0))
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(1), (B, 224, 224, 3), jnp.float32),
+        batch_sharding(mesh, 4))
+    y = jax.device_put(
+        jax.random.randint(jax.random.key(2), (B,), 0, 1000, jnp.int32),
+        batch_sharding(mesh, 1))
+    compiled, flops = _compile_step(train_step, state, x, y)
+    dt, finite = _time_steps(np, compiled, state, x, y)
+    mfu = (flops / dt / (peak_flops * n_chips)
+           if (flops and peak_flops) else None)
+    return {
+        "batch": B, "image": "224x224x3", "step_ms": round(dt * 1000, 2),
+        "samples_per_sec_per_chip": round(B / dt / n_chips, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "xla_flops_per_step": flops, "loss_finite": finite,
+    }
+
+
 def _bench_bert(jax, jnp, np, mesh, n_chips, peak_flops):
     """BASELINE.md rung 3: BERT-base MLM train step in bf16 at T=512,
     samples/sec/chip, tokens/sec/chip and MFU."""
@@ -359,6 +394,7 @@ def main():
 
     gpt2 = _stage(_bench_gpt2, jax, jnp, np, mesh, n_chips, peak)
     resnet = _stage(_bench_resnet18, jax, jnp, np, mesh, n_chips, peak)
+    resnet50 = _stage(_bench_resnet50, jax, jnp, np, mesh, n_chips, peak)
     bert = _stage(_bench_bert, jax, jnp, np, mesh, n_chips, peak)
     attn = _stage(_bench_attention, jax, jnp, np)
 
@@ -377,6 +413,7 @@ def main():
             "n_chips": n_chips,
             "gpt2_small_bf16_t1024": gpt2,
             "resnet18_cifar32_bf16": resnet,
+            "resnet50_imagenet224_bf16": resnet50,
             "bert_base_mlm_bf16_t512": bert,
             "flash_vs_dense_attention_bf16": attn,
         },

@@ -1,6 +1,11 @@
 #!/usr/bin/env python3
 """Decompose the GPT-2-small step time: fwd / fwd+bwd / optimizer, and
-flash vs dense attention inside the full model."""
+flash vs dense attention inside the full model.
+
+CAVEAT (relayed-TPU environments): each timing below carries the constant
+~130 ms host-fetch overhead amortised over its iterations (~6.5 ms/step at
+20 iters) — fine for the relative comparisons this tool exists for, but
+use bench.py's two-length-difference numbers for absolute claims."""
 
 import os
 import sys
@@ -100,9 +105,6 @@ def main():
     print(f"optimizer update only:  {time_opt():.2f} ms")
 
     # dense-attention variant of the full model
-    dense_model = GPT2(cfg)
-    object.__setattr__(dense_model, "config", cfg)
-
     class DenseBlockGPT2(GPT2):
         def _block(self):
             b = super()._block()

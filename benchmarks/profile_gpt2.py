@@ -2,8 +2,12 @@
 """Ad-hoc perf probe for the GPT-2 MFU push (VERDICT r2 next-round #2).
 
 Times flash fwd and fwd+bwd vs dense, then the full GPT-2-small train step,
-on the attached TPU. Not part of bench.py — a working tool whose numbers
-feed commit messages and the _pick_block comment.
+on the attached TPU. Not part of bench.py — a working tool for relative
+comparisons only.
+
+CAVEAT (relayed-TPU environments): every number here carries the constant
+~130 ms host-fetch overhead amortised over its iterations (~2.6 ms/iter at
+50) — use bench.py's two-length-difference numbers for absolute claims.
 """
 
 import os

@@ -1,7 +1,13 @@
-"""Data layer: dataset readers, deterministic sharded sampling, device feed."""
+"""Data layer: dataset readers, deterministic sharded sampling, device feed,
+and out-of-core streaming from sharded files."""
 
 from distributed_compute_pytorch_tpu.data.sampler import ShardedSampler
-from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+from distributed_compute_pytorch_tpu.data.loader import (
+    DeviceFeeder, StreamingDeviceFeeder)
 from distributed_compute_pytorch_tpu.data.datasets import load_dataset, ArrayDataset
+from distributed_compute_pytorch_tpu.data.shards import (
+    ShardedFileDataset, append_shard, write_array_shards)
 
-__all__ = ["ShardedSampler", "DeviceFeeder", "load_dataset", "ArrayDataset"]
+__all__ = ["ShardedSampler", "DeviceFeeder", "StreamingDeviceFeeder",
+           "load_dataset", "ArrayDataset", "ShardedFileDataset",
+           "append_shard", "write_array_shards"]

@@ -340,4 +340,17 @@ def load_dataset(name: str, data_dir: str = "./data", split: str = "train",
         return synthetic_lm(kw.pop("n", 2048), kw.pop("seq_len", 128),
                             kw.pop("vocab", 256),
                             seed=0 if split == "train" else 1)
+    if name == "sharded":
+        # out-of-core streaming dataset (data/shards.py): ``data_dir`` is a
+        # shard directory, or a parent holding train/ and test/ shard dirs
+        from distributed_compute_pytorch_tpu.data.shards import (
+            MANIFEST, ShardedFileDataset)
+        split_dir = os.path.join(data_dir, split)
+        if os.path.exists(os.path.join(split_dir, MANIFEST)):
+            return ShardedFileDataset.open(split_dir)
+        if os.path.exists(os.path.join(data_dir, MANIFEST)):
+            return ShardedFileDataset.open(data_dir)
+        raise FileNotFoundError(
+            f"no {MANIFEST} under {split_dir!r} or {data_dir!r} "
+            f"(build one with data.shards.write_array_shards)")
     raise ValueError(f"unknown dataset {name!r}")

@@ -22,6 +22,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_compute_pytorch_tpu.core.mesh import batch_sharding, local_batch_size
 from distributed_compute_pytorch_tpu.data.datasets import ArrayDataset
 from distributed_compute_pytorch_tpu.data.sampler import ShardedSampler
+from distributed_compute_pytorch_tpu.data.shards import (
+    ShardedFileDataset, ShardStream)
 
 
 def _local_row_span(sharding: NamedSharding, global_shape: tuple[int, ...]) -> slice:
@@ -53,6 +55,25 @@ def _local_row_span(sharding: NamedSharding, global_shape: tuple[int, ...]) -> s
             f"({sorted(spans)}); order the batch axes (data, fsdp) first in "
             "the mesh spec so each host feeds one contiguous row range")
     return slice(lo, hi)
+
+
+def _batch_array_sharding(mesh: Mesh, dataset, ndim: int) -> NamedSharding:
+    """Batch dim over the batch axes; for token arrays ``[B, T]`` the
+    sequence dim additionally shards over ``seq`` (context parallelism).
+    Multi-host note: keep the ``seq`` axis within a host (mesh axis order
+    puts batch axes outermost) so each process still feeds contiguous
+    batch rows."""
+    base = batch_sharding(mesh, ndim)
+    if (ndim == 2 and "seq" in mesh.axis_names and mesh.shape["seq"] > 1):
+        seq_len = dataset.inputs.shape[1]
+        n_seq = mesh.shape["seq"]
+        if seq_len % n_seq:
+            raise ValueError(
+                f"sequence length {seq_len} not divisible by seq axis "
+                f"size {n_seq}")
+        batch_spec = base.spec[0]
+        return NamedSharding(mesh, P(batch_spec, "seq"))
+    return base
 
 
 _SENTINEL = object()
@@ -128,23 +149,7 @@ class DeviceFeeder:
         self.target_sharding = self._sharding_for(dataset.targets.ndim)
 
     def _sharding_for(self, ndim: int) -> NamedSharding:
-        """Batch dim over the batch axes; for token arrays ``[B, T]`` the
-        sequence dim additionally shards over ``seq`` (context parallelism).
-        Multi-host note: keep the ``seq`` axis within a host (mesh axis order
-        puts batch axes outermost) so each process still feeds contiguous
-        batch rows."""
-        base = batch_sharding(self.mesh, ndim)
-        if (ndim == 2 and "seq" in self.mesh.axis_names
-                and self.mesh.shape["seq"] > 1):
-            seq_len = self.dataset.inputs.shape[1]
-            n_seq = self.mesh.shape["seq"]
-            if seq_len % n_seq:
-                raise ValueError(
-                    f"sequence length {seq_len} not divisible by seq axis "
-                    f"size {n_seq}")
-            batch_spec = base.spec[0]
-            return NamedSharding(self.mesh, P(batch_spec, "seq"))
-        return base
+        return _batch_array_sharding(self.mesh, self.dataset, ndim)
 
     def __len__(self) -> int:
         return self.sampler.num_batches
@@ -201,4 +206,114 @@ class DeviceFeeder:
                     valid[-pad:] = 0.0
                 out = (*out, jax.make_array_from_process_local_data(
                     valid_sharding, valid[valid_rows], (self.global_batch,)))
+            yield out
+
+
+class StreamingDeviceFeeder:
+    """The ``DeviceFeeder`` contract over an out-of-core sharded dataset.
+
+    Same surface (``steps_per_epoch``, ``epoch(epoch, skip, with_valid)``)
+    so the trainer is agnostic; rows stream from this host's shard subset
+    (``data/shards.py``) with bounded RAM instead of fancy-indexing an
+    in-memory array.
+
+    Lockstep semantics: ``steps_per_epoch`` is the max over hosts of
+    ``ceil(local_n / local_batch)`` — computable by every host from the
+    manifest alone (no communication). Hosts that exhaust their local rows
+    wrap around their epoch order; wrapped rows carry ``valid=0.0`` so eval
+    weights them out (exact eval, same property as ``DeviceFeeder``'s
+    padding mask).
+    """
+
+    def __init__(self, dataset: ShardedFileDataset, mesh: Mesh,
+                 global_batch: int, shuffle: bool = True, seed: int = 0,
+                 prefetch: int = 2, buffer_shards: int = 2):
+        self.dataset = dataset
+        self.mesh = mesh
+        self.global_batch = global_batch
+        self.prefetch = prefetch
+        local_batch_size(global_batch, mesh)  # raises clearly if indivisible
+        self.input_sharding = _batch_array_sharding(
+            mesh, dataset, 1 + len(dataset.manifest["input_shape"]))
+        self.target_sharding = _batch_array_sharding(
+            mesh, dataset, 1 + len(dataset.manifest["target_shape"]))
+        self.valid_sharding = batch_sharding(mesh, 1)
+
+        in_shape = (global_batch, *dataset.manifest["input_shape"])
+        self._in_shape = in_shape
+        self._tgt_shape = (global_batch, *dataset.manifest["target_shape"])
+        self._rows = _local_row_span(self.input_sharding, in_shape)
+        tgt_rows = _local_row_span(self.target_sharding, self._tgt_shape)
+        if (self._rows.start, self._rows.stop) != (tgt_rows.start,
+                                                   tgt_rows.stop):
+            raise ValueError("input/target row spans disagree")
+        self.local_batch = self._rows.stop - self._rows.start
+
+        n_proc = jax.process_count()
+        if self.global_batch % n_proc:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"{n_proc} processes — required so every host computes the "
+                f"same steps_per_epoch from metadata alone")
+        if self.local_batch != self.global_batch // n_proc:
+            raise ValueError(
+                f"this process feeds {self.local_batch} rows but "
+                f"{self.global_batch // n_proc} expected; order batch axes "
+                f"first in the mesh spec")
+        self.stream = ShardStream(dataset, jax.process_index(), n_proc,
+                                  shuffle=shuffle, seed=seed,
+                                  buffer_shards=buffer_shards)
+        # lockstep step count: same value on every host, from metadata only
+        # (equal local batches were just asserted)
+        self._steps = max(
+            -(-dataset.local_num_examples(p, n_proc) // self.local_batch)
+            for p in range(n_proc))
+
+    def __len__(self) -> int:
+        return self._steps
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self._steps
+
+    def epoch(self, epoch: int = 0, skip: int = 0, with_valid: bool = False
+              ) -> Iterator[tuple[jax.Array, ...]]:
+        it = self._epoch_batches(epoch, skip, with_valid)
+        return _prefetched(it, self.prefetch) if self.prefetch else it
+
+    def _epoch_batches(self, epoch: int, skip: int, with_valid: bool
+                      ) -> Iterator[tuple[jax.Array, ...]]:
+        lb = self.local_batch
+        local_n = self.stream.local_n
+        blocks = self.stream.rows(epoch, start=skip * lb)
+        buf_x: list[np.ndarray] = []
+        buf_y: list[np.ndarray] = []
+        have = 0
+        pos = skip * lb                    # absolute row position (for valid)
+        for b in range(skip, self._steps):
+            while have < lb:
+                x, y = next(blocks)
+                buf_x.append(x)
+                buf_y.append(y)
+                have += len(x)
+            x = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
+            y = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+            bx, by = x[:lb], y[:lb]
+            buf_x, buf_y = [x[lb:]], [y[lb:]]
+            have -= lb
+            out = (
+                jax.make_array_from_process_local_data(
+                    self.input_sharding, np.ascontiguousarray(bx),
+                    self._in_shape),
+                jax.make_array_from_process_local_data(
+                    self.target_sharding, np.ascontiguousarray(by),
+                    self._tgt_shape),
+            )
+            if with_valid:
+                # rows past this host's local_n are wraparound padding
+                row_pos = pos + np.arange(lb)
+                valid = (row_pos < local_n).astype(np.float32)
+                out = (*out, jax.make_array_from_process_local_data(
+                    self.valid_sharding, valid, (self.global_batch,)))
+            pos += lb
             yield out

