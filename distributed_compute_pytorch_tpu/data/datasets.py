@@ -349,6 +349,12 @@ def load_dataset(name: str, data_dir: str = "./data", split: str = "train",
         if os.path.exists(os.path.join(split_dir, MANIFEST)):
             return ShardedFileDataset.open(split_dir)
         if os.path.exists(os.path.join(data_dir, MANIFEST)):
+            if split != "train":
+                warnings.warn(
+                    f"sharded dataset has no {split!r} subdirectory under "
+                    f"{data_dir!r}; the root shard directory serves every "
+                    f"split — eval metrics will be measured on the training "
+                    f"data", stacklevel=2)
             return ShardedFileDataset.open(data_dir)
         raise FileNotFoundError(
             f"no {MANIFEST} under {split_dir!r} or {data_dir!r} "

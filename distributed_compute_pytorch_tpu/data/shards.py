@@ -4,7 +4,7 @@ Role: the reference's data layer (``/root/reference/main.py:107-116``) at the
 BASELINE ladder's multi-host rung (configs[2], ResNet-50/ImageNet) — datasets
 larger than host RAM. ``ArrayDataset`` (``data/datasets.py``) requires the
 whole dataset in memory; this module streams it from a directory of shard
-files instead, holding at most ``buffer_shards`` shards in RAM.
+files instead, holding at most ``buffer_shards + 1`` shards in RAM.
 
 Design (TPU-first, SPMD):
 
@@ -27,9 +27,10 @@ Design (TPU-first, SPMD):
   local example count; hosts that run short wrap around their own stream
   (``DistributedSampler`` padding semantics at host granularity). The
   wrapped rows carry ``valid=0`` so eval stays exact.
-- **RAM bound**: a background thread prefetches the next shard while the
-  current one is consumed; at most ``buffer_shards`` shard arrays exist at
-  once, so peak RAM is O(shard_size), not O(dataset).
+- **RAM bound**: a background thread prefetches upcoming shards while the
+  current one is consumed; at most ``buffer_shards + 1`` shard arrays are
+  resident (the consumer's + ``buffer_shards - 1`` queued + one in flight
+  in the producer), so peak RAM is O(shard_size), not O(dataset).
 """
 
 from __future__ import annotations
@@ -100,6 +101,15 @@ def append_shard(out_dir: str, inputs: np.ndarray, targets: np.ndarray,
                     "target_shape": list(targets.shape[1:]),
                     "target_dtype": str(targets.dtype),
                     "num_classes": 0}
+    if (list(inputs.shape[1:]) != manifest["input_shape"]
+            or str(inputs.dtype) != manifest["input_dtype"]
+            or list(targets.shape[1:]) != manifest["target_shape"]
+            or str(targets.dtype) != manifest["target_dtype"]):
+        raise ValueError(
+            f"appended shard ({inputs.shape[1:]}/{inputs.dtype}, "
+            f"{targets.shape[1:]}/{targets.dtype}) does not match the "
+            f"manifest ({manifest['input_shape']}/{manifest['input_dtype']}, "
+            f"{manifest['target_shape']}/{manifest['target_dtype']})")
     i = len(manifest["shards"])
     fn = f"shard-{i:05d}.npz"
     _atomic_savez(os.path.join(out_dir, fn), inputs=inputs, targets=targets)
@@ -192,7 +202,7 @@ class ShardStream:
     shards before ``start`` are skipped without loading — mid-epoch resume
     costs one partial shard read, not a scan). The caller slices blocks into
     batches. A background thread loads the next shard while the caller
-    consumes the current one; at most ``buffer_shards`` shards are resident.
+    consumes the current one; at most ``buffer_shards + 1`` shards are resident.
     """
 
     def __init__(self, dataset: ShardedFileDataset, process_index: int = 0,
@@ -232,8 +242,7 @@ class ShardStream:
 
     # ---------------------------------------------------------------- io
 
-    def _load(self, epoch: int, order_pos: int):
-        shard_idx = self._epoch_shard_order(epoch)[order_pos]
+    def _load(self, epoch: int, shard_idx: int):
         meta = self.shards[shard_idx]
         with np.load(os.path.join(self.dataset.data_dir, meta["file"])) as z:
             x, y = z["inputs"], z["targets"]
@@ -266,7 +275,7 @@ class ShardStream:
             # synchronous fallback (buffer_shards=1): strictest RAM bound
             p = pos
             while True:
-                x, y = self._load(epoch, p)
+                x, y = self._load(epoch, order[p])
                 yield (x[offset:], y[offset:]) if offset else (x, y)
                 offset = 0
                 p = (p + 1) % len(sizes)
@@ -276,7 +285,7 @@ class ShardStream:
             p = pos
             try:
                 while not stop.is_set():
-                    item = self._load(epoch, p)
+                    item = self._load(epoch, order[p])
                     while not stop.is_set():
                         try:
                             q.put(item, timeout=0.1)

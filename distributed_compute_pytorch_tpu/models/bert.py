@@ -38,6 +38,11 @@ class BertConfig:
     dropout_rate: float = 0.1
     mask_rate: float = 0.15
     mask_token_id: int = 103       # [MASK] in the WordPiece vocab
+    # token id marking padding in variable-length batches ([PAD]=0 in the
+    # WordPiece vocab). When set, attention masks padded keys end-to-end
+    # (flash / dense / ring) and the MLM loss never selects padded
+    # positions. None = fixed-length data (synthetic LM), no masking.
+    pad_token_id: int | None = None
     # GPipe microbatch count under a pipe axis (None = pipe size)
     pipeline_microbatches: int | None = None
     remat: bool = False            # rematerialise blocks on backward
@@ -79,9 +84,23 @@ class BertMLM:
         }
         return params, {}
 
-    def apply(self, params, state, tokens, *, train: bool = False, rng=None):
-        """``tokens [B, T] int32`` -> MLM logits ``[B, T, vocab]``."""
+    def padding_mask(self, tokens):
+        """``[B, T]`` float key-validity mask (1 = real token), or None when
+        the config declares fixed-length data."""
         c = self.config
+        if c.pad_token_id is None:
+            return None
+        return (tokens != c.pad_token_id).astype(jnp.float32)
+
+    def apply(self, params, state, tokens, *, train: bool = False, rng=None,
+              kv_mask=None):
+        """``tokens [B, T] int32`` -> MLM logits ``[B, T, vocab]``.
+
+        ``kv_mask`` overrides the config-derived padding mask (callers that
+        already know validity, e.g. eval with pre-masked inputs)."""
+        c = self.config
+        if kv_mask is None:
+            kv_mask = self.padding_mask(tokens)
         wte = L.Embedding(c.vocab_size, c.d_model)
         wpe = L.Embedding(c.max_seq_len, c.d_model)
         T = tokens.shape[1]
@@ -96,11 +115,19 @@ class BertMLM:
         mesh = current_mesh()
         if (mesh is not None and "pipe" in mesh.axis_names
                 and mesh.shape["pipe"] > 1):
+            if kv_mask is not None:
+                raise NotImplementedError(
+                    "padding masks under pipeline parallelism need the mask "
+                    "microbatched alongside x; set pad_token_id=None or "
+                    "run without a pipe axis")
             x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
                                 num_microbatches=c.pipeline_microbatches,
                                 rng=layers_rng, train=train, remat=c.remat)
         else:
-            x = scan_blocks(block.apply, params["blocks"], x, remat=c.remat,
+            def block_apply(p, h, rng=None, train=False):
+                return block.apply(p, h, rng=rng, train=train,
+                                   kv_mask=kv_mask)
+            x = scan_blocks(block_apply, params["blocks"], x, remat=c.remat,
                             rng=layers_rng, train=train,
                             unroll=c.unroll_layers)
         h = L.Dense(c.d_model, c.d_model).apply(params["mlm_dense"], x)
@@ -111,10 +138,13 @@ class BertMLM:
 
     # --- MLM objective (masking derived from the step rng) ---
 
-    def _mask_inputs(self, tokens, rng):
+    def _mask_inputs(self, tokens, rng, padding_mask=None):
         c = self.config
         r_sel, r_kind, r_rand = jax.random.split(rng, 3)
         selected = jax.random.bernoulli(r_sel, c.mask_rate, tokens.shape)
+        if padding_mask is not None:
+            # never select padded positions for the MLM objective
+            selected = jnp.logical_and(selected, padding_mask > 0.5)
         kind = jax.random.uniform(r_kind, tokens.shape)
         random_tok = jax.random.randint(r_rand, tokens.shape, 0, c.vocab_size)
         masked = jnp.where(kind < 0.8, c.mask_token_id,
@@ -124,23 +154,30 @@ class BertMLM:
 
     def train_loss(self, params, model_state, tokens, targets, rng,
                    train: bool = True):
-        """step.py train protocol: masked-position cross-entropy."""
+        """step.py train protocol: masked-position cross-entropy over
+        real (non-padded) positions only."""
         del targets  # MLM targets are the unmasked tokens themselves
         r_mask, r_drop = jax.random.split(rng)
-        inputs, selected = self._mask_inputs(tokens, r_mask)
+        padding_mask = self.padding_mask(tokens)
+        inputs, selected = self._mask_inputs(tokens, r_mask, padding_mask)
+        # the padding mask comes from the ORIGINAL tokens: [MASK]-ing must
+        # not turn a real position into an attendable-or-not question
         logits, new_state = self.apply(params, model_state, inputs,
-                                       train=train, rng=r_drop)
+                                       train=train, rng=r_drop,
+                                       kv_mask=padding_mask)
         per_tok = L.cross_entropy_with_logits(logits, tokens, "none")
         n_sel = jnp.maximum(selected.sum(), 1)
         loss = jnp.sum(per_tok * selected) / n_sel
         return loss, new_state
 
     def eval_metrics(self, logits, tokens, valid=None):
-        """Eval without masking randomness: score all positions (a stable
-        pseudo-perplexity proxy). ``valid`` weights whole sequences."""
+        """Eval without masking randomness: score all real positions (a
+        stable pseudo-perplexity proxy). ``valid`` weights whole sequences;
+        padded positions additionally weight out per-token."""
         pred = jnp.argmax(logits, axis=-1)
         per_tok = L.cross_entropy_with_logits(logits, tokens, "none")
-        return L.token_eval_metrics(per_tok, pred == tokens, valid)
+        return L.token_eval_metrics(per_tok, pred == tokens, valid,
+                                    token_mask=self.padding_mask(tokens))
 
     def partition_rules(self):
         return tp_partition_rules()

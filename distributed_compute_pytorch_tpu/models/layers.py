@@ -264,17 +264,26 @@ def cross_entropy_with_logits(logits, targets, reduction: str = "mean"):
     return nll_loss(jax.nn.log_softmax(logits, -1), targets, reduction)
 
 
-def token_eval_metrics(per_tok_loss, correct, valid=None):
+def token_eval_metrics(per_tok_loss, correct, valid=None, token_mask=None):
     """Weighted token-level eval sums shared by the LM models.
 
     ``per_tok_loss``/``correct``: float ``[B, T']`` per-token values.
     ``valid``: optional float ``[B]`` sequence mask — 0.0 rows are the
     feeder's wraparound padding and contribute nothing (exact eval).
+    ``token_mask``: optional float ``[B, T]`` per-token mask (1 = real
+    token) — padded positions of variable-length batches weight out. The
+    weight of a loss entry follows its TARGET token: for shifted causal-LM
+    losses (``T' = T-1``, column j scores token j+1) a full-width mask is
+    cropped to its last ``T'`` columns, i.e. ``mask[:, 1:]``; for unshifted
+    losses (BERT, ``T' = T``) it is used as-is.
     """
     per_tok_loss = per_tok_loss.astype(jnp.float32)
     w = (jnp.ones_like(per_tok_loss) if valid is None
          else jnp.broadcast_to(valid[:, None].astype(jnp.float32),
                                per_tok_loss.shape))
+    if token_mask is not None:
+        shift = token_mask.shape[1] - per_tok_loss.shape[1]
+        w = w * token_mask[:, shift:].astype(jnp.float32)
     return {
         "loss_sum": jnp.sum(per_tok_loss * w),
         "correct": jnp.sum(correct.astype(jnp.float32) * w).astype(jnp.int32),

@@ -27,13 +27,16 @@ from distributed_compute_pytorch_tpu.ops import attention as A
 def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
                        seq_axis: str = "seq", attn_impl: str = "auto",
                        dropout_rate: float = 0.0, rng=None,
-                       train: bool = False):
+                       train: bool = False, kv_mask=None):
     """Fused-QKV multi-head attention + output projection + dropout.
 
     The shared attention half of every transformer variant (dense blocks
     here, MoE blocks in ``models/moe.py``), so all of them get the same
     dispatch: the Pallas flash kernel on TPU for eligible shapes, and ring
     attention when the current mesh carries a ``seq`` axis > 1.
+
+    ``kv_mask``: optional ``[batch, seq]`` key-validity (padding) mask —
+    True = attend; honoured by all three paths (flash / dense / ring).
 
     ``params``: ``{"qkv": Dense(d, 3d), "attn_out": Dense(d, d)}`` trees.
     """
@@ -51,9 +54,11 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     if (mesh is not None and seq_axis in mesh.axis_names
             and mesh.shape[seq_axis] > 1):
         # sequence-parallel path: K/V ring over the seq axis
-        o = ring_attention(q, k, v, mesh, seq_axis, causal=causal)
+        o = ring_attention(q, k, v, mesh, seq_axis, causal=causal,
+                           kv_mask=kv_mask)
     else:
-        o = A.attention(q, k, v, causal=causal, impl=attn_impl)
+        o = A.attention(q, k, v, causal=causal, impl=attn_impl,
+                        kv_mask=kv_mask)
     o = A.merge_heads(o)
     o = L.Dense(d, d).apply(params["attn_out"], o)
     return L.dropout(o, dropout_rate, rng, train)
@@ -87,11 +92,12 @@ class TransformerBlock:
             "mlp_out": L.Dense(self.d_ff, d, param_dtype=pd).init(ks[3]),
         }
 
-    def _attn(self, params, x, rng, train):
+    def _attn(self, params, x, rng, train, kv_mask=None):
         return attention_sublayer(
             params, x, num_heads=self.num_heads, causal=self.causal,
             seq_axis=self.seq_axis, attn_impl=self.attn_impl,
-            dropout_rate=self.dropout_rate, rng=rng, train=train)
+            dropout_rate=self.dropout_rate, rng=rng, train=train,
+            kv_mask=kv_mask)
 
     def _mlp(self, params, x, rng, train):
         h = L.Dense(self.d_model, self.d_ff).apply(params["mlp_in"], x)
@@ -99,18 +105,20 @@ class TransformerBlock:
         h = L.Dense(self.d_ff, self.d_model).apply(params["mlp_out"], h)
         return L.dropout(h, self.dropout_rate, rng, train)
 
-    def apply(self, params, x, *, rng=None, train: bool = False):
+    def apply(self, params, x, *, rng=None, train: bool = False,
+              kv_mask=None):
         r1 = r2 = None
         if train and rng is not None:
             r1, r2 = jax.random.split(rng)
         ln1 = L.LayerNorm(self.d_model)
         ln2 = L.LayerNorm(self.d_model)
         if self.pre_ln:
-            x = x + self._attn(params, ln1.apply(params["ln1"], x), r1, train)
+            x = x + self._attn(params, ln1.apply(params["ln1"], x), r1,
+                               train, kv_mask)
             x = x + self._mlp(params, ln2.apply(params["ln2"], x), r2, train)
         else:  # post-LN (BERT)
             x = ln1.apply(params["ln1"],
-                          x + self._attn(params, x, r1, train))
+                          x + self._attn(params, x, r1, train, kv_mask))
             x = ln2.apply(params["ln2"], x + self._mlp(params, x, r2, train))
         return x
 

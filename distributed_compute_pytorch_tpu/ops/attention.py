@@ -44,7 +44,11 @@ def dot_product_attention(q, k, v, *, causal: bool = False, bias=None,
         causal_mask = row >= col - (kv_len - q_len)
         logits = jnp.where(causal_mask, logits, -jnp.inf)
     if mask is not None:
-        logits = jnp.where(mask, logits, -jnp.inf)
+        # finite fill (not -inf): a fully-masked row (padded query) yields a
+        # uniform-garbage softmax instead of NaN, matching the flash kernel;
+        # callers exclude padded positions from every loss, so the garbage
+        # never propagates (and its gradient is zero because do is zero)
+        logits = jnp.where(mask, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
@@ -67,10 +71,14 @@ def _pick_block(t: int) -> int | None:
 
 
 def attention(q, k, v, *, causal: bool = False, scale: float | None = None,
-              impl: str = "auto", block_q: int | None = None,
+              kv_mask=None, impl: str = "auto", block_q: int | None = None,
               block_k: int | None = None):
     """Attention dispatcher: the Pallas flash kernel on TPU when shapes
     allow, the fused-by-XLA dense path otherwise.
+
+    ``kv_mask``: optional ``[batch, kv_len]`` key-validity (padding) mask,
+    True = attend — supported by both paths (the flash kernel streams it
+    blockwise; the dense path broadcasts it over heads and queries).
 
     impl: 'auto' (flash on TPU, dense elsewhere) | 'pallas' (force flash,
     interpret-mode off-TPU — used by tests) | 'xla' (force dense).
@@ -90,13 +98,15 @@ def attention(q, k, v, *, causal: bool = False, scale: float | None = None,
         from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
             flash_attention)
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=bq, block_k=bk)
+                               kv_mask=kv_mask, block_q=bq, block_k=bk)
     if impl == "auto" and eligible and jax.default_backend() == "tpu":
         from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
             flash_attention)
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=bq, block_k=bk)
-    return dot_product_attention(q, k, v, causal=causal, scale=scale)
+                               kv_mask=kv_mask, block_q=bq, block_k=bk)
+    mask = None if kv_mask is None else kv_mask[:, None, None, :].astype(bool)
+    return dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                 mask=mask)
 
 
 def split_heads(x, num_heads: int):

@@ -55,8 +55,12 @@ def _use_interpret() -> bool:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
-                *, scale, causal, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
+                scale, causal, masked, block_q, block_k):
+    if masked:
+        mask_ref, o_ref, lse_ref, acc, m_s, l_s = rest
+    else:
+        mask_ref, (o_ref, lse_ref, acc, m_s, l_s) = None, rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     last_k = pl.num_programs(2) - 1
 
@@ -84,6 +88,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if masked:
+            # [1, Bk] f32 0/1 key-validity row broadcast down the q rows.
+            # _NEG_INF (not -inf) keeps fully-masked rows NaN-free: their
+            # p degenerates to uniform but their upstream do is zero, so no
+            # garbage reaches the gradients (padded positions are excluded
+            # from every loss).
+            s = jnp.where(mask_ref[0, 0][None, :] > 0.5, s, _NEG_INF)
         m_prev = m_s[:, :1]                           # [Bq, 1]
         l_prev = l_s[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
@@ -103,20 +114,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         lse_ref[0] = m_s[:] + jnp.log(jnp.maximum(l_s[:], 1e-30))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _mask_spec(heads, block_k):
+    """BlockSpec for the [B, 1, Tk] f32 key-validity mask: the grid's bh
+    axis maps to batch row bh // heads (every head shares its batch row).
+    Rank-3 with a singleton middle dim because Mosaic requires a rank-2
+    block's sublane dim to be 8-divisible or the full array dim."""
+    return pl.BlockSpec((1, 1, block_k),
+                        lambda b, i, j, h=heads: (b // h, 0, j))
+
+
+def _flash_fwd(q, k, v, kv_mask, heads, scale, causal, block_q, block_k):
     bh, t, d = q.shape
     tk = k.shape[1]
     grid = (bh, t // block_q, tk // block_k)
+    masked = kv_mask is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               masked=masked,
                                block_q=block_q, block_k=block_k)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if masked:
+        in_specs.append(_mask_spec(heads, block_k))
+        args.append(kv_mask)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
@@ -136,7 +163,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
@@ -144,8 +171,12 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, masked, block_q, block_k):
+    if masked:
+        mask_ref, dq_ref, dq_acc = rest
+    else:
+        mask_ref, (dq_ref, dq_acc) = None, rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     last_k = pl.num_programs(2) - 1
 
@@ -170,6 +201,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if masked:
+            s = jnp.where(mask_ref[0, 0][None, :] > 0.5, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = _dot_tt(do, v)
         ds = p * (dp - delta)
@@ -181,9 +214,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_k):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, masked, block_q, block_k):
+    if masked:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        mask_ref, (dk_ref, dv_ref, dk_acc, dv_acc) = None, rest
     ki, qi = pl.program_id(1), pl.program_id(2)
     last_q = pl.num_programs(2) - 1
 
@@ -209,6 +245,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if masked:
+            s = jnp.where(mask_ref[0, 0][None, :] > 0.5, s, _NEG_INF)
         p = jnp.exp(s - lse)                 # [Bq, Bk]
         dv_acc[:] = dv_acc[:] + _dot_nt(p.astype(do.dtype), do)
         dp = _dot_tt(do, v)
@@ -221,7 +259,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, scale, causal, block_q, block_k):
+def _flash_bwd(res, g, kv_mask, heads, scale, causal, block_q, block_k):
     q, k, v, o, lse = res
     bh, t, d = q.shape
     tk = k.shape[1]
@@ -231,39 +269,50 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
     # kernel inputs; the residual itself is stored rank-2
     lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
     delta = jnp.broadcast_to(delta[..., None], lse.shape)
+    masked = kv_mask is not None
+    extra = (kv_mask,) if masked else ()
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+    ]
+    if masked:
+        dq_specs.append(_mask_spec(heads, block_k))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          masked=masked, block_q=block_q, block_k=block_k),
         grid=(bh, t // block_q, tk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
-    )(q, k, v, g.astype(q.dtype), lse, delta)
+    )(q, k, v, g.astype(q.dtype), lse, delta, *extra)
 
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+    ]
+    if masked:
+        # dkv grid is (bh, kv, q): the kv block index is grid arg 1
+        dkv_specs.append(
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, j, i, h=heads: (b // h, 0, j)))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          masked=masked, block_q=block_q, block_k=block_k),
         grid=(bh, tk // block_k, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -277,7 +326,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
-    )(q, k, v, g.astype(q.dtype), lse, delta)
+    )(q, k, v, g.astype(q.dtype), lse, delta, *extra)
     return dq, dk, dv
 
 
@@ -287,29 +336,60 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    o, _ = _flash_fwd(q, k, v, None, 1, scale, causal, block_q, block_k)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    o, lse = _flash_fwd(q, k, v, None, 1, scale, causal, block_q, block_k)
     # keep only one lane of the lane-broadcast lse as the residual: 128x
     # less residual memory held until this layer's backward runs
     return o, (q, k, v, o, lse[..., 0])
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
-    return _flash_bwd(res, g, scale, causal, block_q, block_k)
+    return _flash_bwd(res, g, None, 1, scale, causal, block_q, block_k)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_masked(q, k, v, kv_mask, heads, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, kv_mask, heads, scale, causal,
+                      block_q, block_k)
+    return o
+
+
+def _flash_masked_vjp_fwd(q, k, v, kv_mask, heads, scale, causal,
+                          block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, kv_mask, heads, scale, causal,
+                        block_q, block_k)
+    return o, (q, k, v, o, lse[..., 0], kv_mask)
+
+
+def _flash_masked_vjp_bwd(heads, scale, causal, block_q, block_k, res, g):
+    *res5, kv_mask = res
+    dq, dk, dv = _flash_bwd(tuple(res5), g, kv_mask, heads, scale, causal,
+                            block_q, block_k)
+    # the mask is data, not a differentiable input
+    return dq, dk, dv, jnp.zeros_like(kv_mask)
+
+
+_flash_masked.defvjp(_flash_masked_vjp_fwd, _flash_masked_vjp_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: float | None = None,
+                    kv_mask=None,
                     block_q: int = DEFAULT_BLOCK,
                     block_k: int = DEFAULT_BLOCK):
     """Fused attention: ``[b, h, t, d]`` in, same out. Differentiable.
+
+    ``kv_mask``: optional ``[b, kv_len]`` key-validity mask (bool or 0/1
+    float; True/1 = attend) — the padding mask for variable-length batches.
+    Fully-masked query rows produce finite garbage that callers must
+    exclude from the loss (they do: padded positions never contribute).
 
     Requires q/kv sequence lengths divisible by the block sizes; callers
     (``ops.attention.attention``) fall back to the XLA path otherwise.
@@ -328,5 +408,14 @@ def flash_attention(q, k, v, *, causal: bool = False,
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
-    o = _flash(qf, kf, vf, scale, causal, block_q, block_k)
+    if kv_mask is None:
+        o = _flash(qf, kf, vf, scale, causal, block_q, block_k)
+    else:
+        if kv_mask.shape != (b, tk):
+            raise ValueError(f"kv_mask shape {kv_mask.shape} != {(b, tk)}")
+        # rank-3 [B, 1, Tk] so the kernels' (1, 1, block_k) mask blocks
+        # satisfy Mosaic's tiling rule (see _mask_spec)
+        mask3 = kv_mask.astype(jnp.float32).reshape(b, 1, tk)
+        o = _flash_masked(qf, kf, vf, mask3, h,
+                          scale, causal, block_q, block_k)
     return o.reshape(b, h, t, d)

@@ -30,19 +30,27 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30  # finite "minus infinity": keeps the online softmax NaN-free
 
 
-def _block_attend(q, kb, vb, o, m, l, q_pos, k_pos, scale, causal):
-    """Fold one K/V block into the running (o, m, l) online softmax."""
+def _block_attend(q, kb, vb, o, m, l, q_pos, k_pos, scale, causal,
+                  mask_b=None):
+    """Fold one K/V block into the running (o, m, l) online softmax.
+
+    ``mask_b``: optional ``[b, chunk]`` key-validity block (padding mask)
+    that travelled around the ring with this K/V block."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         allowed = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(allowed, s, _NEG_INF)
+    if mask_b is not None:
+        s = jnp.where(mask_b[:, None, None, :] > 0.5, s, _NEG_INF)
     row_max = jnp.max(s, axis=-1)                       # [b,h,q]
     m_new = jnp.maximum(m, row_max)
     corr = jnp.exp(m - m_new)                           # rescale old mass
     p = jnp.exp(s - m_new[..., None])
     if causal:
         p = jnp.where(allowed[None, None], p, 0.0)
+    if mask_b is not None:
+        p = jnp.where(mask_b[:, None, None, :] > 0.5, p, 0.0)
     l_new = l * corr + jnp.sum(p, axis=-1)
     o_new = o * corr[..., None] + jnp.einsum(
         "bhqk,bhkd->bhqd", p, vb.astype(p.dtype))
@@ -50,13 +58,17 @@ def _block_attend(q, kb, vb, o, m, l, q_pos, k_pos, scale, causal):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
-                   causal: bool = False, scale: float | None = None):
+                   causal: bool = False, scale: float | None = None,
+                   kv_mask=None):
     """Sequence-parallel attention over ``mesh``'s ``axis``.
 
     Args:
       q, k, v: ``[batch, heads, seq, head_dim]`` global arrays whose ``seq``
         dim is (or will be) sharded over ``axis``. batch may additionally be
         sharded over the batch axes; heads over ``tensor``.
+      kv_mask: optional ``[batch, seq]`` key-validity (padding) mask, True =
+        attend; its seq dim shards over ``axis`` and each chunk rotates
+        around the ring with its K/V block.
     Returns the attention output with the same sharding as ``q``.
     """
     *_, seq_len, head_dim = q.shape
@@ -65,7 +77,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
     if n_chunks == 1:
         from distributed_compute_pytorch_tpu.ops.attention import (
             dot_product_attention)
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        mask = (None if kv_mask is None
+                else kv_mask[:, None, None, :].astype(bool))
+        return dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                     mask=mask)
     chunk = seq_len // n_chunks
 
     # batch/head dims keep whatever sharding they already have; we only
@@ -80,10 +95,17 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
     perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
     vary = tuple(a for a in ((batch_axes or ()) + ((head_axes,)
                  if head_axes else ()) + (axis,)))
+    mask_spec = P(batch_axes, axis)
+    masked = kv_mask is not None
+    if masked:
+        kv_mask = kv_mask.astype(jnp.float32)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=((spec, spec, spec, mask_spec) if masked
+                       else (spec, spec, spec)),
              out_specs=spec)
-    def _ring(q, k, v):
+    def _ring(q, k, v, *maybe_mask):
+        mk = maybe_mask[0] if masked else None
         my_chunk = lax.axis_index(axis)
         q_pos = my_chunk * chunk + jnp.arange(chunk)
         b, h, t, d = q.shape
@@ -97,23 +119,26 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
 
         # local block first (no communication), then permute-then-attend for
         # the remaining n-1 blocks — exactly n-1 neighbour exchanges total.
-        o, m, l = _block_attend(q, k, v, o, m, l, q_pos, q_pos, scale, causal)
+        o, m, l = _block_attend(q, k, v, o, m, l, q_pos, q_pos, scale,
+                                causal, mk)
 
         def body(carry, step):
-            o, m, l, kb, vb = carry
+            o, m, l, kb, vb, mb = carry
             kb = lax.ppermute(kb, axis, perm)
             vb = lax.ppermute(vb, axis, perm)
+            if mb is not None:
+                mb = lax.ppermute(mb, axis, perm)
             # after `step` rotations we hold the block that started on
             # device (my_chunk - step) mod P
             src = (my_chunk - step) % n_chunks
             k_pos = src * chunk + jnp.arange(chunk)
             o, m, l = _block_attend(q, kb, vb, o, m, l, q_pos, k_pos,
-                                    scale, causal)
-            return (o, m, l, kb, vb), None
+                                    scale, causal, mb)
+            return (o, m, l, kb, vb, mb), None
 
         if n_chunks > 1:
-            (o, m, l, _, _), _ = lax.scan(body, (o, m, l, k, v),
-                                          jnp.arange(1, n_chunks))
+            (o, m, l, *_), _ = lax.scan(body, (o, m, l, k, v, mk),
+                                        jnp.arange(1, n_chunks))
         return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
-    return _ring(q, k, v)
+    return _ring(q, k, v, kv_mask) if masked else _ring(q, k, v)

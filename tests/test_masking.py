@@ -1,0 +1,201 @@
+"""Attention padding masks end-to-end (VERDICT r2 missing #4): flash kernel,
+dense path, ring attention, and BERT on variable-length padded batches.
+
+All kernel comparisons run in interpret mode on the faked CPU mesh (f32);
+the real-TPU masked-kernel proof lives in tests/test_flash_tpu.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.ops.attention import (
+    attention, dot_product_attention)
+from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
+    flash_attention)
+
+
+def _qkv(B=2, H=4, T=256, D=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), dtype) for k in ks)
+
+
+def _lengths_mask(B, T, lengths):
+    m = np.zeros((B, T), np.float32)
+    for i, n in enumerate(lengths):
+        m[i, :n] = 1.0
+    return jnp.asarray(m)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_masked_flash_matches_masked_dense(causal):
+    B, H, T, D = 2, 4, 256, 64
+    q, k, v = _qkv(B, H, T, D)
+    kv_mask = _lengths_mask(B, T, [200, 131])
+    out = flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                          block_q=128, block_k=128)
+    ref = dot_product_attention(q, k, v, causal=causal,
+                                mask=kv_mask[:, None, None, :].astype(bool))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_masked_flash_grads_match_dense():
+    B, H, T, D = 2, 4, 256, 64
+    q, k, v = _qkv(B, H, T, D)
+    kv_mask = _lengths_mask(B, T, [256, 100])
+    # upstream cotangent zero at padded queries, like a masked loss
+    g_mask = kv_mask[:, None, :, None]
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, kv_mask=kv_mask,
+                            block_q=128, block_k=128)
+        return jnp.sum(o * g_mask)
+
+    def loss_dense(q, k, v):
+        o = dot_product_attention(
+            q, k, v, mask=kv_mask[:, None, None, :].astype(bool))
+        return jnp.sum(o * g_mask)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, ("dq", "dk", "dv")):
+        assert np.isfinite(np.asarray(a)).all(), name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+def test_masked_dispatcher_dense_path():
+    B, H, T, D = 2, 4, 100, 32          # 100 divides no block -> dense
+    q, k, v = _qkv(B, H, T, D)
+    kv_mask = _lengths_mask(B, T, [80, 100])
+    out = attention(q, k, v, kv_mask=kv_mask, impl="auto")
+    ref = dot_product_attention(q, k, v,
+                                mask=kv_mask[:, None, None, :].astype(bool))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_masked_ring_matches_dense(devices8):
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+    from distributed_compute_pytorch_tpu.parallel.ring_attention import (
+        ring_attention)
+
+    mesh = make_mesh("seq=8")
+    B, H, T, D = 2, 2, 64, 16
+    q, k, v = _qkv(B, H, T, D)
+    kv_mask = _lengths_mask(B, T, [50, 33])
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh, "seq", causal=True, kv_mask=kv_mask))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True,
+                                mask=kv_mask[:, None, None, :].astype(bool))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ------------------------------------------------------------------- BERT
+
+
+def _bert(pad_id=0):
+    from distributed_compute_pytorch_tpu.models.bert import (
+        BertConfig, BertMLM)
+    cfg = BertConfig.tiny()
+    import dataclasses
+    cfg = dataclasses.replace(cfg, pad_token_id=pad_id, mask_token_id=2)
+    return BertMLM(cfg)
+
+
+def test_bert_padded_content_does_not_leak(devices8):
+    """With a fixed kv_mask, changing token content at masked positions
+    must leave logits at real positions bit-identical — attention is the
+    only cross-position op, and it must not see padded keys."""
+    model = _bert()
+    params, state = model.init(jax.random.key(0))
+    B, T = 4, 64
+    lengths = [64, 40, 17, 5]
+    kv_mask = _lengths_mask(B, T, lengths)
+    rng = np.random.Generator(np.random.Philox(key=7))
+    toks = rng.integers(3, 256, size=(B, T)).astype(np.int32)
+    toks_a = jnp.asarray(toks)
+    alt = rng.integers(3, 256, size=(B, T)).astype(np.int32)
+    toks_b = jnp.where(kv_mask > 0.5, toks_a, jnp.asarray(alt))
+
+    la, _ = model.apply(params, state, toks_a, kv_mask=kv_mask)
+    lb, _ = model.apply(params, state, toks_b, kv_mask=kv_mask)
+    for i, n in enumerate(lengths):
+        np.testing.assert_array_equal(np.asarray(la[i, :n]),
+                                      np.asarray(lb[i, :n]))
+
+
+def test_bert_trains_on_padded_batches(devices8):
+    """MLM loss on variable-length padded batches: finite, decreasing, and
+    never selecting padded positions."""
+    import optax
+
+    model = _bert()
+    params, state = model.init(jax.random.key(0))
+    B, T = 8, 64
+    lengths = [64, 48, 32, 24, 16, 12, 8, 6]
+    rng = np.random.Generator(np.random.Philox(key=11))
+    toks = rng.integers(3, 256, size=(B, T)).astype(np.int32)
+    mask = np.asarray(_lengths_mask(B, T, lengths))
+    toks = jnp.asarray(np.where(mask > 0.5, toks, 0))   # pad id 0
+
+    # selection never hits padding
+    inputs, selected = model._mask_inputs(
+        toks, jax.random.key(1), model.padding_mask(toks))
+    assert not bool(jnp.logical_and(selected, mask < 0.5).any())
+
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, key):
+        def loss_fn(p):
+            loss, _ = model.train_loss(p, {}, toks, None, key)
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for i in range(30):
+        params, opt_state, loss = step(params, opt_state,
+                                       jax.random.fold_in(jax.random.key(2), i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.5
+
+
+def test_token_eval_metrics_shifted_mask_follows_targets():
+    """For shifted causal-LM losses (T' = T-1, column j scores token j+1)
+    a full-width token mask must weight each loss entry by its TARGET's
+    validity — i.e. crop to mask[:, 1:]."""
+    from distributed_compute_pytorch_tpu.models.layers import (
+        token_eval_metrics)
+
+    # one sequence, T=5, last two tokens padded
+    mask = jnp.asarray([[1.0, 1.0, 1.0, 0.0, 0.0]])
+    per_tok = jnp.ones((1, 4))            # shifted losses for targets 1..4
+    correct = jnp.ones((1, 4), bool)
+    m = token_eval_metrics(per_tok, correct, token_mask=mask)
+    # targets 1 and 2 are real; targets 3 and 4 are padding
+    assert int(m["count"]) == 2
+    assert float(m["loss_sum"]) == 2.0
+
+
+def test_bert_eval_metrics_exclude_padding(devices8):
+    model = _bert()
+    params, state = model.init(jax.random.key(0))
+    B, T = 4, 64
+    lengths = [64, 40, 17, 5]
+    mask = _lengths_mask(B, T, lengths)
+    rng = np.random.Generator(np.random.Philox(key=13))
+    toks = rng.integers(3, 256, size=(B, T)).astype(np.int32)
+    toks = jnp.asarray(np.where(np.asarray(mask) > 0.5, toks, 0))
+    logits, _ = model.apply(params, state, toks)
+    m = model.eval_metrics(logits, toks)
+    assert int(m["count"]) == sum(lengths)
+    m2 = model.eval_metrics(logits, toks,
+                            valid=jnp.asarray([1.0, 1.0, 0.0, 0.0]))
+    assert int(m2["count"]) == 64 + 40
