@@ -65,6 +65,9 @@ class Config:
     ckpt_path: str = "checkpoint.npz"  # reference writes 'mnist.pt' (main.py:133)
     resume: bool = False           # restore path the reference lacks (SURVEY §5.4)
     import_torch: str | None = None  # start from a reference mnist.pt (interop.py)
+    ckpt_sharded: bool = False     # v2 directory format: each host writes its
+                                   # own shards, no O(params) gather (FSDP-scale)
+    async_checkpoint: bool = False  # overlap the checkpoint write with training
 
     # --- elastic / fault tolerance (SURVEY §5.3; the reference has none) ---
     checkpoint_every: int = 0      # also checkpoint every N steps (0 = per-epoch
@@ -151,6 +154,11 @@ class Config:
                             "only, like the reference's download=True)")
         p.add_argument("--ckpt_path", type=str, default=cls.ckpt_path)
         p.add_argument("--resume", action="store_true")
+        p.add_argument("--ckpt_sharded", action="store_true",
+                       help="sharded checkpoint directory: each host writes "
+                            "its own shards (no O(params) gather)")
+        p.add_argument("--async_checkpoint", action="store_true",
+                       help="write checkpoints on a background thread")
         p.add_argument("--import_torch", type=str, default=None,
                        help="initialise from a reference torch checkpoint "
                             "(mnist.pt); convnet only")

@@ -125,9 +125,11 @@ class MeshSpec:
                 raise ValueError(
                     f"{n_devices} devices not divisible by fixed axes {sizes}")
             sizes[unknown[0]] = n_devices // known
-        elif known != n_devices:
+        elif known > n_devices:
             raise ValueError(
                 f"mesh {sizes} wants {known} devices, have {n_devices}")
+        # known < n_devices is allowed: make_mesh undersubscribes onto the
+        # first `known` devices (elastic resize / deliberate partial use)
         return MeshSpec(axes=tuple(sizes.items()))
 
     @property
@@ -157,6 +159,25 @@ def make_mesh(spec: str | dict[str, int] | MeshSpec = "data=-1",
     if devices is None:
         devices = jax.devices()
     spec = spec.resolve(len(devices))
+    total = int(np.prod(spec.shape))
+    if total < len(devices):
+        # an explicit spec smaller than the attached device set is the
+        # elastic-resize case (resume a preempted v4-32 run on a v4-8, or
+        # deliberately undersubscribe a shared host): use the first N.
+        # Single-process only — in a multi-process run devices[:N] could
+        # strip every device of a later process, which would then hang in
+        # the first collective; resize across hosts by relaunching with
+        # fewer processes instead.
+        if jax.process_count() > 1:
+            raise ValueError(
+                f"mesh spec {dict(zip(spec.names, spec.shape))} uses "
+                f"{total} of {len(devices)} devices; undersubscription is "
+                f"single-process only — relaunch with fewer processes")
+        import warnings
+        warnings.warn(
+            f"mesh spec {dict(zip(spec.names, spec.shape))} uses "
+            f"{total} of {len(devices)} devices", stacklevel=2)
+        devices = devices[:total]
     dev_array = np.asarray(devices).reshape(spec.shape)
     return Mesh(dev_array, axis_names=spec.names)
 

@@ -4,20 +4,38 @@ The reference saves once, at end of training, from *every* rank to the same
 path (``/root/reference/main.py:133`` — a write race, SURVEY §A.6) and has no
 restore path at all. Here (SURVEY §5.4):
 
-- exactly one logical writer (the coordinator process),
-- a stable schema independent of the parallelism strategy (arrays are saved
-  unsharded, so a checkpoint written under FSDP restores under pure DP and
-  vice versa),
+- exactly one logical writer per datum (coordinator for the single-file
+  format; each process for its own shards in the sharded format),
+- a stable schema independent of the parallelism strategy (a checkpoint
+  written under FSDP restores under pure DP, a different mesh size — the
+  elastic-resize path — and vice versa),
 - a restore path, including restore-into-sharded-layout.
 
-Format: a single ``.npz`` of path-flattened leaves plus a JSON manifest
-(step/epoch/format version) — no framework-specific pickle, loadable with
-plain numpy.
+Two formats:
+
+- **v1 single-file** (default, ``save``): one ``.npz`` of path-flattened
+  unsharded leaves + JSON manifest. Simple, portable — but gathering every
+  leaf to one host is O(total params) host RAM and defeats FSDP at scale.
+- **v2 sharded** (``save_sharded``): a DIRECTORY. Each process writes only
+  its addressable shard data (``part-NNNNN.npz`` + ``part-NNNNN.json``
+  listing each entry's leaf and index span) with no cross-host
+  communication and no full-leaf materialisation; the coordinator commits
+  ``manifest.json`` last. ``restore`` reassembles any mesh layout via
+  ``jax.make_array_from_callback``, reading only the spans each host needs.
+
+``AsyncCheckpointer`` overlaps the file write with training: the
+device->host fetch is synchronous (the values must be this step's), the
+serialisation+write happens on a background thread, and the next save (or
+close) joins the previous write first.
+
+No framework-specific pickle anywhere — everything is plain numpy + JSON.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import threading
 from typing import Any
 
 import jax
@@ -29,7 +47,9 @@ from distributed_compute_pytorch_tpu.utils.fsio import atomic_write
 
 PyTree = Any
 _FORMAT_VERSION = 1
+_SHARDED_VERSION = 2
 _SEP = "::"
+_MANIFEST = "manifest.json"
 
 
 def _flatten(tree: PyTree) -> dict[str, np.ndarray]:
@@ -62,6 +82,18 @@ def _gather_host(tree: PyTree) -> PyTree:
     return jax.tree.map(fetch, tree)
 
 
+def _write_v1(path: str, host_tree, epoch: int, extra: dict | None) -> None:
+    """Serialise + atomically write an (already host-gathered) tree as the
+    v1 single file. Shared by the sync and async paths so the schema cannot
+    drift between them."""
+    flat = _flatten(host_tree)
+    manifest = {"format": _FORMAT_VERSION, "epoch": epoch,
+                "extra": extra or {}}
+    atomic_write(path,
+                 lambda f: np.savez(f, __manifest__=json.dumps(manifest),
+                                    **flat))
+
+
 def save(path: str, state, *, epoch: int = 0, extra: dict | None = None) -> None:
     """Write ``state`` (a TrainState or any pytree) to ``path``.
 
@@ -71,17 +103,285 @@ def save(path: str, state, *, epoch: int = 0, extra: dict | None = None) -> None
     host_tree = _gather_host(state)   # collective: all processes participate
     if not is_coordinator():
         return
-    flat = _flatten(host_tree)
-    manifest = {"format": _FORMAT_VERSION, "epoch": epoch,
-                "extra": extra or {}}
-    atomic_write(path,
-                 lambda f: np.savez(f, __manifest__=json.dumps(manifest),
-                                    **flat))
+    _write_v1(path, host_tree, epoch, extra)
 
 
 def load_manifest(path: str) -> dict:
+    if os.path.isdir(path):
+        with open(os.path.join(path, _MANIFEST)) as f:
+            return json.load(f)
     with np.load(path, allow_pickle=False) as z:
         return json.loads(str(z["__manifest__"]))
+
+
+# ---------------------------------------------------------------------------
+# v2 sharded format
+# ---------------------------------------------------------------------------
+
+
+def _unwrap_keys(tree: PyTree) -> PyTree:
+    """PRNG-key leaves -> raw uint32 data (key dtype rejects np.asarray)."""
+    def unwrap(x):
+        if isinstance(x, jax.Array) and jnp.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            return jax.random.key_data(x)
+        return x
+    return jax.tree.map(unwrap, tree)
+
+
+def _span_of(index: tuple, shape: tuple[int, ...]) -> list[list[int]]:
+    """Normalise a device-shard index (tuple of slices) to [[lo, hi], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        lo = sl.start or 0
+        hi = sl.stop if sl.stop is not None else dim
+        out.append([int(lo), int(hi)])
+    # index tuples can be shorter than rank (trailing dims unsharded)
+    for dim in shape[len(index):]:
+        out.append([0, int(dim)])
+    return out
+
+
+def save_sharded(path: str, state, *, epoch: int = 0,
+                 extra: dict | None = None) -> None:
+    """Write ``state`` as a sharded checkpoint DIRECTORY at ``path``.
+
+    Each process writes exactly the index spans it is the *lowest-indexed
+    owner* of — replicated leaves are written once (by the span's first
+    owner, the coordinator for fully-replicated ones), sharded leaves are
+    written without ever materialising the full array, and no cross-host
+    gather happens at all. The coordinator writes ``manifest.json`` last as
+    the commit point (readers treat a directory without it as incomplete).
+    """
+    state = _unwrap_keys(state)
+    pid = jax.process_index()
+    n_proc = jax.process_count()
+    os.makedirs(path, exist_ok=True)
+    if is_coordinator():
+        # uncommit first: a crash between here and the final manifest write
+        # must leave the directory readable as "incomplete", never as a mix
+        # of this save's parts under the previous save's manifest
+        old = os.path.join(path, _MANIFEST)
+        if os.path.exists(old):
+            os.unlink(old)
+        # drop stale parts from a previous, larger process count (elastic
+        # resize): restore reads part files strictly by the new manifest's
+        # num_parts, but leaving dead files invites confusion
+        for fn in os.listdir(path):
+            if fn.startswith("part-"):
+                try:
+                    idx = int(fn.split("-")[1].split(".")[0])
+                except ValueError:
+                    continue
+                if idx >= n_proc:
+                    os.unlink(os.path.join(path, fn))
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dcp:ckpt-sharded-uncommit")
+    flat_entries: dict[str, np.ndarray] = {}
+    part_index: list[dict] = []
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = _SEP.join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        if not isinstance(leaf, jax.Array):
+            # host scalars/arrays are replicated by construction
+            if is_coordinator():
+                arr = np.asarray(leaf)
+                name = f"{key}@full"
+                flat_entries[name] = arr
+                part_index.append({"key": key, "entry": name,
+                                   "span": _span_of((), arr.shape)})
+            continue
+        shape = leaf.shape
+        # lowest process index owning each distinct span writes it; every
+        # process can compute ownership from the (global) sharding map, so
+        # no communication is needed
+        owners: dict[tuple, int] = {}
+        for dev, idx in leaf.sharding.devices_indices_map(shape).items():
+            span = tuple(tuple(s) for s in _span_of(idx, shape))
+            p = dev.process_index
+            if span not in owners or p < owners[span]:
+                owners[span] = p
+        mine = {span for span, p in owners.items() if p == pid}
+        for shard in leaf.addressable_shards:
+            span = tuple(tuple(s) for s in _span_of(shard.index, shape))
+            if span not in mine:
+                continue
+            mine.discard(span)      # each distinct span once per process
+            name = f"{key}@" + ",".join(f"{lo}:{hi}" for lo, hi in span)
+            flat_entries[name] = np.asarray(shard.data)
+            part_index.append({"key": key, "entry": name,
+                               "span": [list(s) for s in span]})
+    part_file = f"part-{pid:05d}.npz"
+    atomic_write(os.path.join(path, part_file),
+                 lambda f: np.savez(f, **flat_entries))
+    atomic_write(os.path.join(path, f"part-{pid:05d}.json"),
+                 lambda f: json.dump({"file": part_file,
+                                      "entries": part_index}, f),
+                 mode="w")
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dcp:ckpt-sharded-parts")
+    if is_coordinator():
+        manifest = {"format": _SHARDED_VERSION, "epoch": epoch,
+                    "extra": extra or {},
+                    "num_parts": n_proc}
+        atomic_write(os.path.join(path, _MANIFEST),
+                     lambda f: json.dump(manifest, f), mode="w")
+
+
+def _sharded_entry_map(path: str) -> dict[str, list[tuple[str, str, list]]]:
+    """leaf key -> [(part_file, entry_name, span), ...].
+
+    Reads exactly the ``num_parts`` part manifests the committed manifest
+    names — stale parts from an earlier save with more processes are never
+    consulted."""
+    manifest = load_manifest(path)
+    n = int(manifest.get("num_parts", 0))
+    entries: dict[str, list] = {}
+    for i in range(n):
+        part_path = os.path.join(path, f"part-{i:05d}.json")
+        if not os.path.exists(part_path):
+            raise FileNotFoundError(
+                f"{path}: manifest names {n} parts but part {i} is missing "
+                f"(incomplete or corrupted checkpoint)")
+        with open(part_path) as f:
+            part = json.load(f)
+        for e in part["entries"]:
+            entries.setdefault(e["key"], []).append(
+                (part["file"], e["entry"], e["span"]))
+    return entries
+
+
+def _assemble(path: str, pieces, span_lo, out):
+    """Fill ``out`` (whose global position starts at ``span_lo``) from any
+    overlapping saved pieces. ``pieces``: [(file, entry, span), ...]."""
+    zcache: dict[str, Any] = {}
+    try:
+        for fname, entry, span in pieces:
+            # overlap of [span] with [span_lo, span_lo+out.shape)
+            sel_src, sel_dst = [], []
+            ok = True
+            for (lo, hi), olo, n in zip(span, span_lo, out.shape):
+                s = max(lo, olo)
+                e = min(hi, olo + n)
+                if s >= e:
+                    ok = False
+                    break
+                sel_src.append(slice(s - lo, e - lo))
+                sel_dst.append(slice(s - olo, e - olo))
+            if not ok:
+                continue
+            if fname not in zcache:
+                zcache[fname] = np.load(os.path.join(path, fname),
+                                        allow_pickle=False)
+            data = zcache[fname][entry]
+            out[tuple(sel_dst)] = data[tuple(sel_src)]
+    finally:
+        for z in zcache.values():
+            z.close()
+
+
+def _restore_sharded(path: str, template, shardings=None):
+    entries = _sharded_entry_map(path)
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    flat_shardings = (jax.tree_util.tree_leaves(shardings)
+                      if shardings is not None else [None] * len(paths))
+    leaves = []
+    for (path_keys, leaf), shard in zip(paths, flat_shardings):
+        key = _SEP.join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path_keys)
+        if key not in entries:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        pieces = entries[key]
+        is_key = isinstance(leaf, jax.Array) and jnp.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key)
+        shape = tuple(jax.random.key_data(leaf).shape if is_key
+                      else np.shape(leaf))
+        dtype = (jax.random.key_data(leaf).dtype if is_key
+                 else getattr(leaf, "dtype", None))
+
+        def read_span(index, shape=shape, dtype=dtype, pieces=pieces):
+            lo = [sl.start or 0 for sl in index] + [0] * (len(shape) - len(index))
+            n = [((sl.stop if sl.stop is not None else shape[i])
+                  - (sl.start or 0)) for i, sl in enumerate(index)]
+            n += list(shape[len(index):])
+            out = np.zeros(tuple(n), dtype)
+            _assemble(path, pieces, lo, out)
+            return out
+
+        if shard is not None and not is_key:
+            # each host reads only the spans its devices need — restore
+            # stays O(local shard bytes) even when the mesh changed size
+            # (elastic resize) or layout (FSDP <-> DP)
+            new = jax.make_array_from_callback(shape, shard, read_span)
+        else:
+            full = read_span(tuple(slice(0, s) for s in shape))
+            if is_key:
+                new = jax.random.wrap_key_data(jnp.asarray(full))
+            else:
+                new = jnp.asarray(full, dtype=dtype)
+            if shard is not None:
+                new = jax.device_put(new, shard)
+        leaves.append(new)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint writes with training.
+
+    ``save`` fetches/serialises the state synchronously only as far as
+    required for correctness (device->host copies of this step's values),
+    then hands the file write to a background thread. A new ``save`` (or
+    ``close``/context exit) joins the previous write first, so at most one
+    write is in flight and the newest checkpoint always wins. Exceptions
+    from the writer surface on the next call.
+    """
+
+    def __init__(self, sharded: bool = False):
+        self.sharded = sharded
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+
+    def _join(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def save(self, path: str, state, *, epoch: int = 0,
+             extra: dict | None = None) -> None:
+        self._join()
+        if self.sharded:
+            # sharded save is collective (barrier before the manifest
+            # commit), so it runs inline; the per-process write itself is
+            # already O(local shards)
+            save_sharded(path, state, epoch=epoch, extra=extra)
+            return
+        host_tree = _gather_host(state)       # synchronous: step's values
+        if not is_coordinator():
+            return
+
+        def write():
+            try:
+                _write_v1(path, host_tree, epoch, extra)
+            except BaseException as e:  # noqa: BLE001 — re-raised on join
+                self._error = e
+
+        self._thread = threading.Thread(target=write, daemon=True,
+                                        name="dcp-ckpt-write")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def restore(path: str, template, shardings=None):
@@ -90,8 +390,12 @@ def restore(path: str, template, shardings=None):
     ``template`` provides structure/dtypes (e.g. a freshly-initialised
     TrainState); ``shardings`` (optional, same structure) places each leaf
     directly into its mesh layout — restore-into-FSDP works without ever
-    materialising the full model on one device per leaf batch.
+    materialising the full model on one device per leaf batch. Both formats
+    restore under ANY mesh (elastic resize): the v1 file holds unsharded
+    leaves; the v2 directory is reassembled span-by-span.
     """
+    if os.path.isdir(path):
+        return _restore_sharded(path, template, shardings)
     with np.load(path, allow_pickle=False) as z:
         flat = {k: z[k] for k in z.files if k != "__manifest__"}
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)

@@ -158,6 +158,8 @@ class Trainer:
             log0(f"imported torch checkpoint {config.import_torch}")
         self.heartbeat = (Heartbeat(config.heartbeat_path)
                           if config.heartbeat_path else None)
+        self.checkpointer = (checkpoint.AsyncCheckpointer(
+            sharded=config.ckpt_sharded) if config.async_checkpoint else None)
 
         self.logger = MetricLogger()
         log0(f"mesh: {dict(self.mesh.shape)} | dp world size: "
@@ -217,6 +219,27 @@ class Trainer:
             kw["param_dtype"] = jnp.dtype(cfg.param_dtype)
         return kw
 
+    def _save_ckpt(self, epoch: int, extra: dict | None = None) -> None:
+        """One checkpoint write via the configured path: async (background
+        thread), sharded (per-host shard files, no O(params) gather), or
+        the default coordinator-written single file."""
+        cfg = self.config
+        if self.checkpointer is not None:
+            self.checkpointer.save(cfg.ckpt_path, self.state, epoch=epoch,
+                                   extra=extra)
+        elif cfg.ckpt_sharded:
+            checkpoint.save_sharded(cfg.ckpt_path, self.state, epoch=epoch,
+                                    extra=extra)
+        else:
+            checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
+                            extra=extra)
+
+    def _finish(self) -> None:
+        """Flush any in-flight async checkpoint write, then the logger."""
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+        self.logger.close()
+
     def train_epoch(self, epoch: int, skip: int = 0,
                     guard: PreemptionGuard | None = None) -> float:
         """One epoch; returns mean wall-time-throughput (samples/s).
@@ -241,16 +264,14 @@ class Trainer:
                 if self.heartbeat is not None:
                     self.heartbeat.beat(epoch, epoch * steps + b)
             if guard is not None and guard.preempted:
-                checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
-                                extra={"step_in_epoch": b + 1})
+                self._save_ckpt(epoch, extra={"step_in_epoch": b + 1})
                 log0(f"preempted at epoch {epoch} step {b}; "
                      f"checkpoint written to {cfg.ckpt_path}")
                 raise Preempted()
             if (cfg.checkpoint_every
                     and (b + 1) % cfg.checkpoint_every == 0
                     and b + 1 < steps):
-                checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
-                                extra={"step_in_epoch": b + 1})
+                self._save_ckpt(epoch, extra={"step_in_epoch": b + 1})
         # fence via a device->host fetch of a value depending on the last
         # step: block_until_ready can ack early on relayed TPU transports,
         # which would overstate samples/s (bench.py uses the same fence)
@@ -306,8 +327,7 @@ class Trainer:
                 # epoch save) keeps us inside a short preemption grace
                 # window; eval_done=False makes the resume backfill the
                 # interrupted eval so its metrics line is never lost
-                checkpoint.save(self.config.ckpt_path, self.state,
-                                epoch=epoch, extra={"eval_done": False})
+                self._save_ckpt(epoch, extra={"eval_done": False})
                 log0(f"preempted during epoch {epoch} eval; checkpoint "
                      f"written to {self.config.ckpt_path}")
                 raise Preempted()
@@ -353,10 +373,9 @@ class Trainer:
                 try:
                     last_eval = self.evaluate(pending, guard=guard)
                 except Preempted:
-                    self.logger.close()
+                    self._finish()
                     return {"preempted": True, "epoch": pending}
-                checkpoint.save(cfg.ckpt_path, self.state, epoch=pending,
-                                extra={"eval_done": True})
+                self._save_ckpt(pending, extra={"eval_done": True})
                 self._pending_eval_epoch = None
             for epoch in range(self.start_epoch, cfg.epochs):
                 skip = self.start_step if epoch == self.start_epoch else 0
@@ -366,11 +385,10 @@ class Trainer:
                                                   guard=guard)
                     last_eval = self.evaluate(epoch, guard=guard)
                 except Preempted:
-                    self.logger.close()
+                    self._finish()
                     return {"preempted": True, "epoch": epoch}
                 self.logger.epoch_time(epoch, timer.elapsed(), throughput)
-                checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
-                                extra={"eval_done": True})
+                self._save_ckpt(epoch, extra={"eval_done": True})
                 if guard.preempted:
                     # signal arrived after eval (eval-time signals raise
                     # Preempted inside evaluate()): during the epoch-time
@@ -379,7 +397,7 @@ class Trainer:
                     # starting another epoch.
                     log0(f"preempted during epoch {epoch} epoch-end save; "
                          f"checkpoint written to {cfg.ckpt_path}")
-                    self.logger.close()
+                    self._finish()
                     return {"preempted": True, "epoch": epoch}
-        self.logger.close()
+        self._finish()
         return last_eval
