@@ -142,6 +142,14 @@ def _span_of(index: tuple, shape: tuple[int, ...]) -> list[list[int]]:
     return out
 
 
+def exists(path: str) -> bool:
+    """Is there a COMMITTED checkpoint at ``path``? A sharded directory
+    without its manifest (crash mid-save) counts as no checkpoint."""
+    if os.path.isdir(path):
+        return os.path.exists(os.path.join(path, _MANIFEST))
+    return os.path.isfile(path)
+
+
 def save_sharded(path: str, state, *, epoch: int = 0,
                  extra: dict | None = None) -> None:
     """Write ``state`` as a sharded checkpoint DIRECTORY at ``path``.
@@ -150,34 +158,25 @@ def save_sharded(path: str, state, *, epoch: int = 0,
     owner* of — replicated leaves are written once (by the span's first
     owner, the coordinator for fully-replicated ones), sharded leaves are
     written without ever materialising the full array, and no cross-host
-    gather happens at all. The coordinator writes ``manifest.json`` last as
-    the commit point (readers treat a directory without it as incomplete).
+    gather happens at all.
+
+    Crash safety: every save is a new *generation* — part files are named
+    ``part-g{G}-NNNNN`` and the commit point is the atomic replace of
+    ``manifest.json`` (which records G). A crash mid-save leaves the
+    previous generation's manifest and parts untouched; the half-written
+    new generation is pruned by the next successful save. Every process
+    derives G by reading the current manifest itself (only the coordinator
+    ever writes it, and saves are collectively ordered), so no
+    communication is needed.
     """
     state = _unwrap_keys(state)
     pid = jax.process_index()
     n_proc = jax.process_count()
     os.makedirs(path, exist_ok=True)
-    if is_coordinator():
-        # uncommit first: a crash between here and the final manifest write
-        # must leave the directory readable as "incomplete", never as a mix
-        # of this save's parts under the previous save's manifest
-        old = os.path.join(path, _MANIFEST)
-        if os.path.exists(old):
-            os.unlink(old)
-        # drop stale parts from a previous, larger process count (elastic
-        # resize): restore reads part files strictly by the new manifest's
-        # num_parts, but leaving dead files invites confusion
-        for fn in os.listdir(path):
-            if fn.startswith("part-"):
-                try:
-                    idx = int(fn.split("-")[1].split(".")[0])
-                except ValueError:
-                    continue
-                if idx >= n_proc:
-                    os.unlink(os.path.join(path, fn))
-    if n_proc > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("dcp:ckpt-sharded-uncommit")
+    try:
+        gen = int(load_manifest(path).get("generation", -1)) + 1
+    except FileNotFoundError:
+        gen = 0
     flat_entries: dict[str, np.ndarray] = {}
     part_index: list[dict] = []
     for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
@@ -190,7 +189,8 @@ def save_sharded(path: str, state, *, epoch: int = 0,
                 name = f"{key}@full"
                 flat_entries[name] = arr
                 part_index.append({"key": key, "entry": name,
-                                   "span": _span_of((), arr.shape)})
+                                   "span": _span_of((), arr.shape),
+                                   "gshape": list(arr.shape)})
             continue
         shape = leaf.shape
         # lowest process index owning each distinct span writes it; every
@@ -211,11 +211,12 @@ def save_sharded(path: str, state, *, epoch: int = 0,
             name = f"{key}@" + ",".join(f"{lo}:{hi}" for lo, hi in span)
             flat_entries[name] = np.asarray(shard.data)
             part_index.append({"key": key, "entry": name,
-                               "span": [list(s) for s in span]})
-    part_file = f"part-{pid:05d}.npz"
+                               "span": [list(s) for s in span],
+                               "gshape": list(shape)})
+    part_file = f"part-g{gen}-{pid:05d}.npz"
     atomic_write(os.path.join(path, part_file),
                  lambda f: np.savez(f, **flat_entries))
-    atomic_write(os.path.join(path, f"part-{pid:05d}.json"),
+    atomic_write(os.path.join(path, f"part-g{gen}-{pid:05d}.json"),
                  lambda f: json.dump({"file": part_file,
                                       "entries": part_index}, f),
                  mode="w")
@@ -225,40 +226,50 @@ def save_sharded(path: str, state, *, epoch: int = 0,
     if is_coordinator():
         manifest = {"format": _SHARDED_VERSION, "epoch": epoch,
                     "extra": extra or {},
-                    "num_parts": n_proc}
+                    "generation": gen, "num_parts": n_proc}
+        # COMMIT: atomic replace; the previous generation stays valid
+        # until this succeeds
         atomic_write(os.path.join(path, _MANIFEST),
                      lambda f: json.dump(manifest, f), mode="w")
+        # best-effort prune of all other generations (now-dead data)
+        for fn in os.listdir(path):
+            if fn.startswith("part-") and not fn.startswith(f"part-g{gen}-"):
+                try:
+                    os.unlink(os.path.join(path, fn))
+                except OSError:
+                    pass
 
 
-def _sharded_entry_map(path: str) -> dict[str, list[tuple[str, str, list]]]:
-    """leaf key -> [(part_file, entry_name, span), ...].
+def _sharded_entry_map(path: str) -> dict[str, list]:
+    """leaf key -> [(part_file, entry_name, span, gshape), ...].
 
-    Reads exactly the ``num_parts`` part manifests the committed manifest
-    names — stale parts from an earlier save with more processes are never
-    consulted."""
+    Reads exactly the ``num_parts`` part manifests of the committed
+    manifest's generation — parts from other (stale or half-written)
+    generations are never consulted."""
     manifest = load_manifest(path)
     n = int(manifest.get("num_parts", 0))
+    gen = int(manifest.get("generation", 0))
     entries: dict[str, list] = {}
     for i in range(n):
-        part_path = os.path.join(path, f"part-{i:05d}.json")
+        part_path = os.path.join(path, f"part-g{gen}-{i:05d}.json")
         if not os.path.exists(part_path):
             raise FileNotFoundError(
-                f"{path}: manifest names {n} parts but part {i} is missing "
-                f"(incomplete or corrupted checkpoint)")
+                f"{path}: manifest names {n} parts of generation {gen} but "
+                f"part {i} is missing (incomplete or corrupted checkpoint)")
         with open(part_path) as f:
             part = json.load(f)
         for e in part["entries"]:
             entries.setdefault(e["key"], []).append(
-                (part["file"], e["entry"], e["span"]))
+                (part["file"], e["entry"], e["span"], e.get("gshape")))
     return entries
 
 
 def _assemble(path: str, pieces, span_lo, out):
     """Fill ``out`` (whose global position starts at ``span_lo``) from any
-    overlapping saved pieces. ``pieces``: [(file, entry, span), ...]."""
+    overlapping saved pieces. ``pieces``: [(file, entry, span, gshape), ...]."""
     zcache: dict[str, Any] = {}
     try:
-        for fname, entry, span in pieces:
+        for fname, entry, span, _ in pieces:
             # overlap of [span] with [span_lo, span_lo+out.shape)
             sel_src, sel_dst = [], []
             ok = True
@@ -300,6 +311,14 @@ def _restore_sharded(path: str, template, shardings=None):
                       else np.shape(leaf))
         dtype = (jax.random.key_data(leaf).dtype if is_key
                  else getattr(leaf, "dtype", None))
+        saved_shape = pieces[0][3]
+        if saved_shape is not None and tuple(saved_shape) != shape:
+            # without this check the span-assembly would silently zero-fill
+            # the uncovered region of a resized leaf
+            raise ValueError(
+                f"checkpoint leaf {key!r} was saved with shape "
+                f"{tuple(saved_shape)} but the template wants {shape} — "
+                f"model configuration changed since the save")
 
         def read_span(index, shape=shape, dtype=dtype, pieces=pieces):
             lo = [sl.start or 0 for sl in index] + [0] * (len(shape) - len(index))

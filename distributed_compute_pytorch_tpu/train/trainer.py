@@ -107,7 +107,13 @@ class Trainer:
         self.start_step = 0            # step within start_epoch (mid-epoch resume)
         self._pending_eval_epoch = None  # epoch trained but not yet evaluated
         self._resumed = False
-        if config.resume and os.path.exists(config.ckpt_path):
+        if (config.resume and os.path.exists(config.ckpt_path)
+                and not checkpoint.exists(config.ckpt_path)):
+            # a sharded directory without a committed manifest: a save
+            # crashed before its commit point — start fresh, don't wedge
+            log0(f"WARNING: {config.ckpt_path} exists but holds no "
+                 f"committed checkpoint (interrupted save?); starting fresh")
+        if config.resume and checkpoint.exists(config.ckpt_path):
             manifest = checkpoint.load_manifest(config.ckpt_path)
             # restore each leaf straight into its strategy layout — the
             # freshly-initialised state already carries the right shardings
