@@ -92,7 +92,13 @@ class Config:
     compute_dtype: str = "float32"   # bfloat16 for TPU speed; float32 for parity tests
     param_dtype: str = "float32"
     donate: bool = True              # donate train-state buffers to the jitted step
-    remat: bool = False              # rematerialise transformer blocks on backward
+    # rematerialise transformer blocks on backward (jax.checkpoint): one
+    # extra forward buys ~2-4x batch when HBM binds
+    remat: bool = False
+    # remat granularity: 'block' (each transformer block), or 'stage' (each
+    # pipeline-stage tick — the 1F1B memory profile; needs a pipe>1 mesh,
+    # see parallel/pipeline.py)
+    remat_mode: str = "block"
                                      # (jax.checkpoint): trades one extra forward
                                      # for ~2-4x batch when HBM binds
     compile_cache_dir: str | None = field(
@@ -185,6 +191,10 @@ class Config:
         p.add_argument("--process_id", type=int, default=None)
         p.add_argument("--compute_dtype", type=str, default=cls.compute_dtype)
         p.add_argument("--param_dtype", type=str, default=cls.param_dtype)
+        p.add_argument("--remat_mode", type=str, default=cls.remat_mode,
+                       choices=("block", "stage"),
+                       help="remat granularity: per-block, or per-pipeline-"
+                            "stage (1F1B memory profile; pipe meshes only)")
         p.add_argument("--remat", action="store_true",
                        help="rematerialise transformer blocks on backward "
                             "(bigger batches when HBM binds)")

@@ -45,7 +45,8 @@ class BertConfig:
     pad_token_id: int | None = None
     # GPipe microbatch count under a pipe axis (None = pipe size)
     pipeline_microbatches: int | None = None
-    remat: bool = False            # rematerialise blocks on backward
+    remat: bool | str = False      # rematerialise blocks on backward
+                                   # (True/"block"; "stage" under pipe)
     unroll_layers: bool = True     # python-loop blocks (see GPT2Config)
     param_dtype: jnp.dtype = jnp.float32
 

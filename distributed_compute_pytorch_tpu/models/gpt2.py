@@ -45,7 +45,8 @@ class GPT2Config:
     # rematerialise blocks on backward (jax.checkpoint): ~2-4x batch for one
     # extra forward — the HBM-bound trade (proven: B=32 GPT-2-small fits one
     # v5e chip with remat; B=16 doesn't without)
-    remat: bool = False
+    remat: bool | str = False   # True/"block" per-block; "stage" = 1F1B
+                                # memory profile under a pipe mesh
     # python-loop the blocks instead of lax.scan: XLA schedules across the
     # whole depth and residuals skip the scan's dynamic-update-slice
     # stacking (-17% step time on v5e at 12 layers); scan for very deep

@@ -21,6 +21,24 @@ torch-style stage-module wrapper:
 
 Bubble fraction is ``(P-1)/(M+P-1)``; the default ``M = P`` gives ~half
 idle, callers raise ``num_microbatches`` to amortise.
+
+**On 1F1B**: in a single-program SPMD lockstep pipeline the 1F1B schedule
+and GPipe execute the *same number of ticks* — fwd phase ``M+P-1`` plus
+bwd phase ``M+P-1`` (autodiff reverses the scan) — so their bubble
+fractions are identical; interleaving fwd/bwd ticks cannot shorten a
+lockstep program whose loss (and therefore every cotangent) is computed
+after all microbatch forwards. What 1F1B actually buys on a
+multi-controller runtime is *peak activation memory*: at most ``P``
+microbatches in flight instead of ``M``. Here that profile is delivered
+by rematerialisation instead: ``remat="stage"`` checkpoints each stage
+tick at its *input* — residual memory per stage is ``M`` stage inputs
+(``M*mb*T*d``) rather than every intermediate of every block — and the
+backward recomputes the stage forward, exactly what a 1F1B worker does
+when it runs a microbatch's backward. The bubble-reduction lever this
+unlocks is raising ``M`` (bubble ``(P-1)/(M+P-1)`` shrinks) with memory
+that no longer scales with the full per-block activation footprint;
+``tests/test_pipeline.py`` measures the throughput gain at ``M=P`` vs
+``M=4P``.
 """
 
 from __future__ import annotations
@@ -103,7 +121,8 @@ def scan_blocks(block_apply, stacked_params, x, *, rng=None,
 
 def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
                     axis: str = "pipe", *, num_microbatches: int | None = None,
-                    rng=None, train: bool = False, remat: bool = False):
+                    rng=None, train: bool = False,
+                    remat: bool | str = False):
     """Run stacked layers as a GPipe pipeline over ``mesh``'s ``axis``.
 
     Args:
@@ -112,7 +131,12 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         divisible by the pipe size ``P`` (each stage owns ``L/P`` layers).
         Shard dim 0 over ``pipe`` (see ``transformer.tp_partition_rules``).
       x: activations ``[B, T, d]``; ``B`` must divide ``num_microbatches``.
-      num_microbatches: GPipe ``M`` (default ``P``).
+      num_microbatches: GPipe ``M`` (default ``P``); raise it to shrink the
+        ``(P-1)/(M+P-1)`` bubble.
+      remat: ``False`` (save every intermediate), ``True``/``"block"``
+        (checkpoint each block — residuals are block inputs), or
+        ``"stage"`` (checkpoint each stage tick — residuals are stage
+        inputs only, the 1F1B memory profile; see module docstring).
 
     Returns activations ``[B, T, d]``, replicated over ``pipe`` (other mesh
     axes keep their shardings — only ``pipe`` is manual here).
@@ -137,7 +161,8 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     mb = B // M
     perm = [(i, (i + 1) % P_size) for i in range(P_size)]
 
-    apply = remat_wrap(block_apply) if remat else block_apply
+    apply = (remat_wrap(block_apply) if remat in (True, "block")
+             else block_apply)
 
     def stage_fn(params_local, h, stage, mb_id):
         def layer_body(h, scanned):
@@ -149,6 +174,15 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             return apply(p, h, rng=r, train=train), None
         h, _ = lax.scan(layer_body, h, (jnp.arange(L_local), params_local))
         return h
+
+    if remat == "stage":
+        # 1F1B memory profile: the only residual autodiff keeps per tick is
+        # the stage INPUT; the whole stage forward (all L/P blocks) is
+        # recomputed when its backward tick runs
+        stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
+    elif remat not in (False, True, "block"):
+        raise ValueError(f"remat must be False, True/'block' or 'stage', "
+                         f"got {remat!r}")
 
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(P(axis), P()), out_specs=P(),

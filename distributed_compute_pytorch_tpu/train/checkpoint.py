@@ -173,6 +173,12 @@ def save_sharded(path: str, state, *, epoch: int = 0,
     pid = jax.process_index()
     n_proc = jax.process_count()
     os.makedirs(path, exist_ok=True)
+    if n_proc > 1:
+        # order generation derivation after the previous save's commit:
+        # without this, a fast process could enter save N+1 and read the
+        # gen-(N-1) manifest while the coordinator still writes gen N
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dcp:ckpt-sharded-begin")
     try:
         gen = int(load_manifest(path).get("generation", -1)) + 1
     except FileNotFoundError:
@@ -248,13 +254,18 @@ def _sharded_entry_map(path: str) -> dict[str, list]:
     generations are never consulted."""
     manifest = load_manifest(path)
     n = int(manifest.get("num_parts", 0))
-    gen = int(manifest.get("generation", 0))
+    gen = manifest.get("generation")
     entries: dict[str, list] = {}
     for i in range(n):
-        part_path = os.path.join(path, f"part-g{gen}-{i:05d}.json")
+        if gen is None:
+            # pre-generation layout (manifests without the key): unprefixed
+            # part names
+            part_path = os.path.join(path, f"part-{i:05d}.json")
+        else:
+            part_path = os.path.join(path, f"part-g{int(gen)}-{i:05d}.json")
         if not os.path.exists(part_path):
             raise FileNotFoundError(
-                f"{path}: manifest names {n} parts of generation {gen} but "
+                f"{path}: manifest names {n} parts (generation {gen}) but "
                 f"part {i} is missing (incomplete or corrupted checkpoint)")
         with open(part_path) as f:
             part = json.load(f)

@@ -217,7 +217,14 @@ class Trainer:
             kw["pipeline_microbatches"] = cfg.microbatches
         if cfg.remat:
             if cfg.model in ("bert", "gpt2", "moe"):
-                kw["remat"] = True
+                stage_ok = (cfg.remat_mode == "stage"
+                            and cfg.model != "moe"
+                            and dict(self.mesh.shape).get("pipe", 1) > 1)
+                if cfg.remat_mode == "stage" and not stage_ok:
+                    log0("WARNING: --remat_mode stage needs a pipe>1 mesh "
+                         "and a bert/gpt2 model; falling back to per-block "
+                         "remat")
+                kw["remat"] = "stage" if stage_ok else True
             else:
                 log0(f"WARNING: --remat is not supported by model "
                      f"{cfg.model!r} and will be ignored")

@@ -176,8 +176,6 @@ def test_sharded_save_generations_and_stale_parts(tmp_path, devices8):
 def test_sharded_restore_rejects_shape_mismatch(tmp_path, devices8):
     """A template whose leaf shapes differ from the save must raise, not
     silently zero-fill the uncovered region."""
-    import dataclasses
-
     import pytest
 
     mesh = make_mesh("data=8", devices=devices8)
@@ -185,19 +183,43 @@ def test_sharded_restore_rejects_shape_mismatch(tmp_path, devices8):
     path = str(tmp_path / "ckpt_dir")
     checkpoint.save_sharded(path, state, epoch=0)
 
-    from distributed_compute_pytorch_tpu.models.convnet import ConvNet
-    from distributed_compute_pytorch_tpu.train.optim import adadelta_steplr
-    from distributed_compute_pytorch_tpu.train.step import make_step_fns
-    bigger = ConvNet(hidden=256) if "hidden" in [
-        f.name for f in dataclasses.fields(ConvNet)] else None
-    if bigger is None:
-        # no size knob on ConvNet: fake the mismatch by doubling a leaf
-        template, _ = _fresh_state(mesh, DataParallel())
-        k = template.params["fc1"]["kernel"]
-        template.params["fc1"]["kernel"] = jax.numpy.zeros(
-            (k.shape[0] * 2, k.shape[1]), k.dtype)
-        with pytest.raises(ValueError, match="saved with shape"):
-            checkpoint.restore(path, template)
+    # fake a model-size change by doubling one leaf in the template
+    template, _ = _fresh_state(mesh, DataParallel())
+    k = template.params["fc1"]["kernel"]
+    template.params["fc1"]["kernel"] = jax.numpy.zeros(
+        (k.shape[0] * 2, k.shape[1]), k.dtype)
+    with pytest.raises(ValueError, match="saved with shape"):
+        checkpoint.restore(path, template)
+
+
+def test_sharded_restore_pre_generation_layout(tmp_path, devices8):
+    """Checkpoints written before the generation protocol (unprefixed part
+    names, no 'generation' manifest key) must still restore."""
+    import json
+
+    mesh = make_mesh("data=8", devices=devices8)
+    state, _ = _fresh_state(mesh, DataParallel())
+    path = str(tmp_path / "ckpt_dir")
+    checkpoint.save_sharded(path, state, epoch=0)
+    # rewrite to the old layout
+    man_path = os.path.join(path, "manifest.json")
+    with open(man_path) as f:
+        man = json.load(f)
+    gen = man.pop("generation")
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    for ext in (".json", ".npz"):
+        os.rename(os.path.join(path, f"part-g{gen}-00000{ext}"),
+                  os.path.join(path, f"part-00000{ext}"))
+    with open(os.path.join(path, "part-00000.json")) as f:
+        part = json.load(f)
+    part["file"] = "part-00000.npz"
+    with open(os.path.join(path, "part-00000.json"), "w") as f:
+        json.dump(part, f)
+
+    template, _ = _fresh_state(mesh, DataParallel())
+    restored = checkpoint.restore(path, template)
+    _assert_states_equal(state, restored)
 
 
 def test_async_checkpointer_single_file(tmp_path, devices8):

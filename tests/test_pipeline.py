@@ -71,6 +71,66 @@ def test_pipeline_gradients_match_scan(devices8):
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("remat", [True, "stage"])
+def test_pipeline_remat_matches_scan(devices8, remat):
+    """Block- and stage-level remat change only what autodiff saves, never
+    the numerics: outputs AND gradients == plain scan."""
+    mesh = make_mesh("pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(2), L=8)
+    x = jax.random.normal(jax.random.key(3), (8, 4, 16))
+
+    def loss_scan(p):
+        return scan_blocks(apply, p, x).sum()
+
+    def loss_pipe(p):
+        return pipeline_blocks(apply, p, x, mesh, num_microbatches=4,
+                               remat=remat).sum()
+
+    g_ref = jax.jit(jax.grad(loss_scan))(params)
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_pipe)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_remat_validates_mode(devices8):
+    mesh = make_mesh("pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0), L=4)
+    with pytest.raises(ValueError, match="remat"):
+        pipeline_blocks(apply, params, jnp.zeros((4, 4, 16)), mesh,
+                        remat="bogus")
+
+
+def test_more_microbatches_shrink_bubble(devices8):
+    """The measured bubble: at pipe=4, per-sample wall time at M=4P must
+    beat M=P — the (P-1)/(M+P-1) idle fraction falling from 43% to 16%
+    predicts a 1.47x gap. This holds even on a single host core: every
+    faked device executes every tick (bubble ticks compute discarded
+    values), so idle ticks cost real wall time either way. Best-of-7
+    bounds scheduler noise; the margin asks for only a fraction of the
+    predicted gap."""
+    import time
+
+    mesh = make_mesh("pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(2), L=4, d=256)
+    x = jax.random.normal(jax.random.key(3), (32, 64, 256))
+
+    def timed(microbatches):
+        f = jax.jit(lambda p, x: pipeline_blocks(
+            apply, p, x, mesh, num_microbatches=microbatches))
+        jax.block_until_ready(f(params, x))      # compile
+        best = 1e9
+        for _ in range(7):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(params, x))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_small, t_big = timed(4), timed(16)
+    assert t_big < t_small * 0.97, (t_small, t_big)
+
+
 def test_layer_count_validation(devices8):
     mesh = make_mesh("pipe=8", devices=devices8)
     apply, params = _stacked_mlp(jax.random.key(0), L=4)   # 4 % 8 != 0
