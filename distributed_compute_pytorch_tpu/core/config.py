@@ -99,8 +99,6 @@ class Config:
     # pipeline-stage tick — the 1F1B memory profile; needs a pipe>1 mesh,
     # see parallel/pipeline.py)
     remat_mode: str = "block"
-                                     # (jax.checkpoint): trades one extra forward
-                                     # for ~2-4x batch when HBM binds
     compile_cache_dir: str | None = field(
         default_factory=lambda: _env("DCP_COMPILE_CACHE"))
                                      # persistent XLA compile cache (skip

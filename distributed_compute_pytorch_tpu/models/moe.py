@@ -5,11 +5,16 @@ Capability beyond the reference (whose only model is a dense CNN,
 ``expert`` axis real. The design is the TPU-idiomatic GShard/Switch
 formulation rather than a gather/scatter one:
 
-- **Einsum dispatch**: top-1 (Switch) routing builds a one-hot dispatch
-  tensor ``[tokens, experts, capacity]``; dispatch and combine are plain
-  einsums, so the whole layer is static-shaped matmuls the MXU likes — no
-  sorting, no dynamic shapes, fully differentiable (through the combine
-  weights).
+- **Einsum dispatch**: top-1 (Switch) or top-2 (GShard) routing builds a
+  one-hot dispatch tensor ``[groups, group_tokens, experts, capacity]``;
+  dispatch and combine are plain einsums, so the whole layer is
+  static-shaped matmuls the MXU likes — no sorting, no dynamic shapes,
+  fully differentiable (through the combine weights).
+- **Routing groups**: the dispatch tensor over all N tokens at once costs
+  ``capacity_factor * N^2`` elements (capacity scales as N/E, so E cancels
+  — the known GShard wall). Routing within groups of ``group_size`` tokens
+  (GShard's "groups") cuts that to ``capacity_factor * N * group_size``,
+  linear in N, at the cost of per-group capacity boundaries.
 - **Expert parallelism as sharding**: expert weights are stacked
   ``[E, ...]`` and sharded over ``expert``; a ``sharding_constraint`` pins
   the dispatched activations ``[E, C, d]`` to the same axis, and XLA's SPMD
@@ -52,12 +57,20 @@ def _constrain(x, spec: P):
 
 @dataclass(frozen=True)
 class MoELayer:
-    """Switch-style top-1 MoE MLP: router + E expert FFNs (d -> ff -> d)."""
+    """Top-1 (Switch) / top-2 (GShard) MoE MLP: router + E expert FFNs.
+
+    ``group_size``: tokens per routing group (must divide the token count;
+    None = one global group — exact Switch semantics, quadratic dispatch).
+    ``top_k``: 1 or 2; with 2, the second expert's gate is renormalised
+    against the first (GShard) and top-1 assignments take queue priority.
+    """
 
     d_model: int
     d_ff: int
     num_experts: int
     capacity_factor: float = 1.25
+    top_k: int = 1
+    group_size: int | None = None
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -73,8 +86,9 @@ class MoELayer:
             "b_out": jnp.zeros((E, d), self.param_dtype),
         }
 
-    def capacity(self, num_tokens: int) -> int:
-        c = int(self.capacity_factor * num_tokens / self.num_experts)
+    def capacity(self, group_tokens: int) -> int:
+        c = int(self.capacity_factor * self.top_k * group_tokens
+                / self.num_experts)
         return max(c, 1)
 
     def apply(self, params, x):
@@ -83,46 +97,78 @@ class MoELayer:
         ``loss + lb_weight*aux['lb_loss'] + z_weight*aux['z_loss']``)."""
         B, T, d = x.shape
         E = self.num_experts
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
         N = B * T
-        C = self.capacity(N)
-        xf = x.reshape(N, d)
+        Ng = self.group_size or N         # tokens per routing group
+        if N % Ng:
+            raise ValueError(f"group_size {Ng} does not divide {N} tokens")
+        G = N // Ng
+        C = self.capacity(Ng)
+        xg = x.reshape(G, Ng, d)
 
-        logits = (xf @ params["router"]["kernel"].astype(x.dtype)
-                  ).astype(jnp.float32)                        # [N, E]
+        logits = jnp.einsum(
+            "gnd,de->gne", xg,
+            params["router"]["kernel"].astype(x.dtype)
+        ).astype(jnp.float32)                                  # [G, Ng, E]
         probs = jax.nn.softmax(logits, -1)
-        gate = jnp.max(probs, -1)                              # [N]
-        expert_idx = jnp.argmax(probs, -1)                     # [N]
-        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
 
-        # position of each token within its expert's queue (0-based);
-        # tokens past capacity are dropped (combine weight 0)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot     # [N, E]
-        keep = (pos < C) * onehot                              # [N, E]
-        pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
-                                dtype=jnp.float32)                 # [N, C]
-        dispatch = keep[:, :, None] * pos_oh[:, None, :]       # [N, E, C]
+        def slot(p, prio_count):
+            """Route one top-k slot: (onehot, queue position, keep mask).
+
+            ``prio_count [G, E]``: expert queue occupancy from higher-
+            priority slots — this slot's positions start after it."""
+            idx = jnp.argmax(p, -1)                            # [G, Ng]
+            oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [G, Ng, E]
+            pos = (jnp.cumsum(oh, axis=1) - oh) * oh           # [G, Ng, E]
+            pos = pos + prio_count[:, None, :] * oh
+            keep = (pos < C) * oh
+            return oh, pos, keep
+
+        oh1, pos1, keep1 = slot(probs, jnp.zeros((G, E), jnp.float32))
+        gate1 = jnp.max(probs, -1)                             # [G, Ng]
+        slots = [(keep1, pos1, gate1)]
+        if self.top_k == 2:
+            probs2 = probs * (1.0 - oh1)       # mask the chosen expert
+            oh2, pos2, keep2 = slot(probs2, oh1.sum(axis=1))
+            gate2 = jnp.max(probs2, -1)
+            # GShard gate renormalisation over the two chosen experts
+            denom = jnp.maximum(gate1 + gate2, 1e-9)
+            slots = [(keep1, pos1, gate1 / denom),
+                     (keep2, pos2, gate2 / denom)]
+
+        # dispatch/combine as sums over slots — [G, Ng, E, C] one-hots;
+        # memory capacity_factor*top_k*N*Ng (linear in N for fixed groups)
+        dispatch = jnp.zeros((G, Ng, E, C), x.dtype)
+        combine = jnp.zeros((G, Ng, E, C), x.dtype)
+        for keep, pos, gate in slots:
+            pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
+                                    dtype=jnp.float32)         # [G, Ng, C]
+            piece = keep[..., None] * pos_oh[:, :, None, :]
+            dispatch = dispatch + piece.astype(x.dtype)
+            combine = combine + (piece * gate[..., None, None]
+                                 ).astype(x.dtype)
 
         # ---- expert compute, sharded over the expert axis ----
-        ein = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xf)
-        ein = _constrain(ein, P("expert", None, None))
-        h = jnp.einsum("ecd,edf->ecf", ein,
+        ein = jnp.einsum("gnec,gnd->gecd", dispatch, xg)
+        ein = _constrain(ein, P(None, "expert", None, None))
+        h = jnp.einsum("gecd,edf->gecf", ein,
                        params["w_in"].astype(x.dtype))
-        h = jax.nn.gelu(h + params["b_in"].astype(x.dtype)[:, None, :])
-        out = jnp.einsum("ecf,efd->ecd", h,
+        h = jax.nn.gelu(h + params["b_in"].astype(x.dtype)[None, :, None, :])
+        out = jnp.einsum("gecf,efd->gecd", h,
                          params["w_out"].astype(x.dtype))
-        out = out + params["b_out"].astype(x.dtype)[:, None, :]
-        out = _constrain(out, P("expert", None, None))
+        out = out + params["b_out"].astype(x.dtype)[None, :, None, :]
+        out = _constrain(out, P(None, "expert", None, None))
 
-        # dispatch already zeroes dropped tokens; weight kept ones by gate
-        combine = (dispatch * gate[:, None, None]).astype(x.dtype)
-        y = jnp.einsum("nec,ecd->nd", combine, out)
+        y = jnp.einsum("gnec,gecd->gnd", combine, out)
 
-        # Switch aux losses (float32 for stability)
-        frac_tokens = onehot.mean(0)                           # [E]
-        frac_probs = probs.mean(0)                             # [E]
+        # Switch aux losses over top-1 assignments (float32 for stability)
+        frac_tokens = oh1.mean((0, 1))                         # [E]
+        frac_probs = probs.mean((0, 1))                        # [E]
         lb_loss = E * jnp.sum(frac_tokens * frac_probs)
         z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, -1)))
-        dropped = 1.0 - keep.sum() / N
+        kept = sum(keep.sum() for keep, _, _ in slots)
+        dropped = 1.0 - kept / (N * len(slots))
         aux = {"lb_loss": lb_loss, "z_loss": z_loss,
                "dropped_fraction": dropped}
         return y.reshape(B, T, d), aux
@@ -138,6 +184,8 @@ class MoETransformerConfig:
     d_ff: int = 3072
     num_experts: int = 8
     capacity_factor: float = 1.25
+    top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
+    moe_group_size: int | None = None  # routing group tokens (None = global)
     lb_weight: float = 0.01
     z_weight: float = 1e-3
     dropout_rate: float = 0.0
@@ -166,7 +214,8 @@ class MoETransformerLM:
     def _moe(self) -> MoELayer:
         c = self.config
         return MoELayer(c.d_model, c.d_ff, c.num_experts, c.capacity_factor,
-                        c.param_dtype)
+                        top_k=c.top_k, group_size=c.moe_group_size,
+                        param_dtype=c.param_dtype)
 
     def _block_init(self, key):
         c = self.config
@@ -228,19 +277,21 @@ class MoETransformerLM:
                        else self._block_apply)
 
         def body(carry, scanned):
-            h, lb, z = carry
+            h, lb, z, dr = carry
             i, p = scanned
             r = (jax.random.fold_in(rng, i)
                  if (rng is not None and train) else None)
             h, aux = block_apply(p, h, r, train)
-            return (h, lb + aux["lb_loss"], z + aux["z_loss"]), None
+            return (h, lb + aux["lb_loss"], z + aux["z_loss"],
+                    dr + aux["dropped_fraction"]), None
 
-        (x, lb, z), _ = jax.lax.scan(
-            body, (x, jnp.float32(0), jnp.float32(0)),
+        (x, lb, z, dr), _ = jax.lax.scan(
+            body, (x, jnp.float32(0), jnp.float32(0), jnp.float32(0)),
             (jnp.arange(L_n), params["blocks"]))
         x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
         logits = wte.attend(params["wte"], x)
-        self_aux = {"lb_loss": lb / L_n, "z_loss": z / L_n}
+        self_aux = {"lb_loss": lb / L_n, "z_loss": z / L_n,
+                    "dropped_fraction": dr / L_n}
         return (logits, self_aux), state
 
     # --- step.py train protocol (owns its objective: aux losses) ---

@@ -141,10 +141,15 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     Returns activations ``[B, T, d]``, replicated over ``pipe`` (other mesh
     axes keep their shardings — only ``pipe`` is manual here).
     """
+    if remat not in (False, True, "block", "stage"):
+        raise ValueError(f"remat must be False, True/'block' or 'stage', "
+                         f"got {remat!r}")
     P_size = mesh.shape[axis]
     if P_size == 1:
+        # no pipe: stage remat degrades to block remat (the only stage is
+        # the whole stack; per-block is the strictly better grain there)
         return scan_blocks(block_apply, stacked_params, x, rng=rng,
-                           train=train, remat=remat)
+                           train=train, remat=bool(remat))
     if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
         raise NotImplementedError(
             "pipe and seq axes cannot be combined yet: ring attention nests "
@@ -180,9 +185,6 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         # the stage INPUT; the whole stage forward (all L/P blocks) is
         # recomputed when its backward tick runs
         stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
-    elif remat not in (False, True, "block"):
-        raise ValueError(f"remat must be False, True/'block' or 'stage', "
-                         f"got {remat!r}")
 
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(P(axis), P()), out_specs=P(),
