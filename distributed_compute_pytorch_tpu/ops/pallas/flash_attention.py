@@ -110,8 +110,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     def _write():
         l = l_s[:, :1]
         o_ref[0] = (acc[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        # lanes broadcast to 128 to satisfy the TPU (8, 128) tiling rule
-        lse_ref[0] = m_s[:] + jnp.log(jnp.maximum(l_s[:], 1e-30))
+        # single-lane output: a lane dim equal to the full array dim (1)
+        # satisfies the tiling rule without broadcasting to 128 lanes —
+        # 128x less lse traffic than the lane-broadcast layout
+        lse_ref[0] = m_s[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _mask_spec(heads, block_k):
@@ -146,11 +148,11 @@ def _flash_fwd(q, k, v, kv_mask, heads, scale, causal, block_q, block_k):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, t, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -192,8 +194,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, :1]                       # [Bq, 1]
-        delta = delta_ref[0][:, :1]                   # [Bq, 1]
+        lse = lse_ref[0]                              # [Bq, 1]
+        delta = delta_ref[0]                          # [Bq, 1]
         s = _dot_tt(q, k) * scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -236,8 +238,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
+        lse = lse_ref[0]                              # [Bq, 1]
+        delta = delta_ref[0]                          # [Bq, 1]
         s = _dot_tt(q, k) * scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -264,11 +266,10 @@ def _flash_bwd(res, g, kv_mask, heads, scale, causal, block_q, block_k):
     bh, t, d = q.shape
     tk = k.shape[1]
     do = g.astype(jnp.float32)
-    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)  # [bh, t]
-    # lane-broadcast (transient) to satisfy the (8, 128) tiling rule on
-    # kernel inputs; the residual itself is stored rank-2
-    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
-    delta = jnp.broadcast_to(delta[..., None], lse.shape)
+    # single-lane rank-3 [bh, t, 1]: a lane dim equal to the full array dim
+    # satisfies the tiling rule without a 128-lane broadcast; lse arrives
+    # in this layout from the forward
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)[..., None]
     masked = kv_mask is not None
     extra = (kv_mask,) if masked else ()
 
@@ -277,8 +278,8 @@ def _flash_bwd(res, g, kv_mask, heads, scale, causal, block_q, block_k):
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
     ]
     if masked:
         dq_specs.append(_mask_spec(heads, block_k))
@@ -300,8 +301,8 @@ def _flash_bwd(res, g, kv_mask, heads, scale, causal, block_q, block_k):
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
     ]
     if masked:
         # dkv grid is (bh, kv, q): the kv block index is grid arg 1
@@ -342,9 +343,8 @@ def _flash(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
     o, lse = _flash_fwd(q, k, v, None, 1, scale, causal, block_q, block_k)
-    # keep only one lane of the lane-broadcast lse as the residual: 128x
-    # less residual memory held until this layer's backward runs
-    return o, (q, k, v, o, lse[..., 0])
+    # the [bh, t, 1] single-lane lse flows to the backward unchanged
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
@@ -365,7 +365,7 @@ def _flash_masked_vjp_fwd(q, k, v, kv_mask, heads, scale, causal,
                           block_q, block_k):
     o, lse = _flash_fwd(q, k, v, kv_mask, heads, scale, causal,
                         block_q, block_k)
-    return o, (q, k, v, o, lse[..., 0], kv_mask)
+    return o, (q, k, v, o, lse, kv_mask)
 
 
 def _flash_masked_vjp_bwd(heads, scale, causal, block_q, block_k, res, g):
