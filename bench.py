@@ -425,8 +425,10 @@ def _bench_attention(jax, jnp, np):
 
     out = {}
     # iters scaled so each workload carries >= ~50 ms of device work into
-    # the two-length difference (flash T=1024 is ~0.1 ms/iter)
-    for T, B, iters in ((1024, 4, 500), (4096, 4, 100)):
+    # the two-length difference (flash T=1024 is ~0.1 ms/iter); the T=8192
+    # rung is the long-context case where the dense path's [T, T] logits
+    # (2.1 GB at B=1) start crowding HBM
+    for T, B, iters in ((1024, 4, 500), (4096, 4, 100), (8192, 1, 40)):
         H, D = 8, 64
         ks = jax.random.split(jax.random.key(0), 3)
         q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.bfloat16)

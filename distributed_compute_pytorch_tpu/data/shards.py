@@ -220,11 +220,14 @@ class ShardStream:
 
     def _key(self, epoch: int, shard_idx: int) -> int:
         """One 128-bit Philox key from the full stream identity, so no two
-        (seed, epoch, process, shard) tuples ever share a permutation."""
-        return ((self.seed & 0xFFFFFFFF)
-                | ((epoch & 0xFFFFFFFF) << 32)
-                | ((self.process_index & 0xFFFFFFFF) << 64)
-                | ((shard_idx & 0x7FFFFFFF) << 96))
+        (seed, epoch, process, shard) tuples ever share a permutation.
+        Everything is coerced to python ints: a fixed-width numpy operand
+        (e.g. a shard index from a permutation array, or some backends'
+        process_index) would overflow at the << 64 shifts."""
+        return ((int(self.seed) & 0xFFFFFFFF)
+                | ((int(epoch) & 0xFFFFFFFF) << 32)
+                | ((int(self.process_index) & 0xFFFFFFFF) << 64)
+                | ((int(shard_idx) & 0x7FFFFFFF) << 96))
 
     def _epoch_shard_order(self, epoch: int) -> list[int]:
         if not self.shuffle:

@@ -16,6 +16,9 @@ Cases (VERDICT r2 missing #2 — multi-process coverage beyond pure DP):
 - ``tp``:   GPT-2-tiny, mesh data=4,tensor=2, Megatron TP layout via
             ShardingRules, v1 checkpoint (allgather of tensor-sharded
             leaves across processes).
+- ``stream``: ConvNet, mesh data=8, out-of-core StreamingDeviceFeeder —
+            each process reads only its round-robin shard subset from a
+            shared on-disk sharded dataset (written by process 0).
 
 Usage: python multiproc_worker.py <pid> <nprocs> <port> <out_dir> <case>
 """
@@ -44,6 +47,9 @@ def build_case(case):
         return (model, synthetic_lm(64, 64, 256, seed=0),
                 ShardingRules(rules=model.partition_rules(),
                               fallback=DataParallel()), 32)
+    if case == "stream":
+        return (ConvNet(), synthetic_images(64, (28, 28, 1), 10, seed=0),
+                DataParallel(), 32)
     raise ValueError(f"unknown case {case!r}")
 
 
@@ -52,7 +58,7 @@ def build_case(case):
 # data=2,fsdp=4 every fsdp shard would have a process-0 replica and the
 # sharded save's lowest-owner rule would write everything from part 0
 MESH_FOR_CASE = {"dp": "data=8", "fsdp": "fsdp=8",
-                 "tp": "data=4,tensor=2"}
+                 "tp": "data=4,tensor=2", "stream": "data=8"}
 
 
 def main():
@@ -79,7 +85,28 @@ def main():
 
     mesh = make_mesh(MESH_FOR_CASE[case])   # 8 global devices, 4 addressable
     model, data, strategy, batch = build_case(case)
-    feed = DeviceFeeder(data, mesh, batch, shuffle=True, seed=0)
+    if case == "stream":
+        # coordinator writes the shared sharded dataset; 8 shards round-
+        # robin across 2 processes (4 each); barrier via allgather inside
+        # StreamingDeviceFeeder construction is not needed — use an
+        # explicit sync so process 1 never reads a half-written manifest
+        from jax.experimental import multihost_utils
+
+        from distributed_compute_pytorch_tpu.data.loader import (
+            StreamingDeviceFeeder)
+        from distributed_compute_pytorch_tpu.data.shards import (
+            ShardedFileDataset, write_array_shards)
+        ds_dir = os.path.join(out_dir, "shards")
+        if pid == 0:
+            write_array_shards(ds_dir, data.inputs, data.targets,
+                               shard_size=8)
+        multihost_utils.sync_global_devices("test:shards-written")
+        sharded = ShardedFileDataset.open(ds_dir)
+        assert len(sharded.local_shards(pid, nprocs)) == 4
+        feed = StreamingDeviceFeeder(sharded, mesh, batch, shuffle=True,
+                                     seed=0)
+    else:
+        feed = DeviceFeeder(data, mesh, batch, shuffle=True, seed=0)
     tx = build_optimizer("adadelta", lr=0.5, gamma=0.7, steps_per_epoch=2)
     init_fn, train_step, eval_step = make_step_fns(model, tx, mesh, strategy)
     state = init_fn(jax.random.key(0))
@@ -89,14 +116,28 @@ def main():
         k = state.params["fc1"]["kernel"]
         assert not k.is_fully_addressable, "fsdp leaf should span processes"
 
+    import numpy as np
+
     losses = []
+    checksum = 0.0
     for x, y in feed.epoch(0):
+        if case == "stream":
+            # order-independent epoch-coverage proof: host-side sum of the
+            # LOCAL rows only (a global jnp.sum would be a collective and
+            # need careful cross-process dispatch ordering); the test adds
+            # the two processes' checksums. Stream's batch is purely
+            # data-sharded, so local shards never replicate rows.
+            checksum += float(sum(np.asarray(s.data).sum()
+                                  for s in x.addressable_shards))
         state, m = train_step(state, x, y)
         losses.append(float(m["loss"]))
     em = eval_step(state, x, y)
     metrics = {"losses": losses,
                "eval_loss_sum": float(em["loss_sum"]),
-               "correct": int(em["correct"])}
+               "correct": int(em["correct"]),
+               "input_checksum": checksum}
+    with open(os.path.join(out_dir, f"metrics_{pid}.json"), "w") as f:
+        json.dump(metrics, f)
 
     if case == "fsdp":
         # v2 sharded save: THIS process writes part files for its shards
@@ -105,7 +146,7 @@ def main():
         checkpoint.save(os.path.join(out_dir, "ck.npz"), state, epoch=0)
     if pid == 0:
         with open(os.path.join(out_dir, "metrics.json"), "w") as f:
-            json.dump(metrics, f)
+            json.dump(metrics, f)  # legacy name some tests read
     # all processes print OK so the test can assert both ran to completion
     print(f"WORKER_OK pid={pid}", flush=True)
 
