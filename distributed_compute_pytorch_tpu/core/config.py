@@ -51,6 +51,7 @@ class Config:
     microbatches: int | None = None  # GPipe microbatches under a pipe axis
     dataset: str = "mnist"         # mnist | cifar10 | synthetic-images | synthetic-lm
     optimizer: str = "adadelta"    # adadelta (reference stack) | sgd | adamw
+                                   # | adamw_fused (Pallas single-pass kernel)
 
     # --- logging / metrics (cadence matches main.py:64) ---
     log_every: int = 10            # print a loss line every N steps (main.py:64)

@@ -1,0 +1,179 @@
+"""Fused AdamW update as a single-pass Pallas TPU kernel.
+
+``optax.adamw`` is a chain (scale_by_adam -> add_decayed_weights ->
+scale_by_learning_rate) that in principle makes several passes over the
+O(params) arrays. This kernel performs the entire update — moment
+updates, bias correction, weight decay, parameter step — in ONE pass per
+leaf, reading each input once and writing each output once.
+
+MEASURED VERDICT (GPT-2-small, v5e, 2026-07-30): neutral-to-slightly
+slower than ``optax.adamw`` inside the full train step (143.1 vs
+137.8 ms) — XLA already fuses the optax chain close to the HBM floor,
+and the per-leaf ``pallas_call`` launches (148 leaves) plus the VMEM cap
+on block sizes (7 arrays x block bytes x double-buffering <= 16 MB) eat
+the single-pass advantage. Kept as an opt-in (``--optimizer
+adamw_fused``) with step-for-step optax parity pinned by tests: it is
+the right shape for configs where the optax chain lowers poorly (many
+small chained transforms, non-fusable host callbacks between stages) and
+documents the measured trade for future kernels.
+
+The public wrapper is an ``optax.GradientTransformation`` whose state
+mirrors ``optax.scale_by_adam`` (count + mu/nu pytrees), plus a
+``fused_apply`` method the train step uses to produce new params directly
+(the optax ``update -> apply_updates`` contract would force an extra
+O(params) pass just to materialise the deltas). ``train/step.py`` detects
+``fused_apply`` and skips ``apply_updates``.
+
+Leaves are processed in their natural shape collapsed to 2-D ``[rows,
+cols]`` blocks; Mosaic masks partial edge tiles, so any leaf shape works.
+On CPU (tests) the kernel runs in interpret mode; numerics are pinned
+against ``optax.adamw`` to float32 resolution in
+``tests/test_fused_adamw.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+
+from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
+    _use_interpret)
+
+
+class FusedAdamWState(NamedTuple):
+    count: jax.Array          # int32 step counter (for bias correction + lr)
+    mu: optax.Params
+    nu: optax.Params
+
+
+def _adamw_kernel(g_ref, p_ref, mu_ref, nu_ref, sc_ref,
+                  new_p_ref, new_mu_ref, new_nu_ref, *, b1, b2, eps):
+    """One block: the full AdamW update, elementwise.
+
+    ``sc_ref`` is a tiny prefetched scalar block ``[lr, wd, c1, c2]`` where
+    ``c1 = 1/(1-b1^t)`` and ``c2 = 1/(1-b2^t)`` are the bias corrections
+    (computed once on host-side scalars, not per element).
+    """
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    mu = mu_ref[...].astype(jnp.float32)
+    nu = nu_ref[...].astype(jnp.float32)
+    lr, wd, c1, c2 = (sc_ref[0, 0], sc_ref[0, 1], sc_ref[0, 2],
+                      sc_ref[0, 3])
+    mu = b1 * mu + (1.0 - b1) * g
+    nu = b2 * nu + (1.0 - b2) * g * g
+    mhat = mu * c1
+    vhat = nu * c2
+    update = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    new_p_ref[...] = (p - lr * update).astype(new_p_ref.dtype)
+    new_mu_ref[...] = mu.astype(new_mu_ref.dtype)
+    new_nu_ref[...] = nu.astype(new_nu_ref.dtype)
+
+
+def _as_2d(x):
+    """Collapse to [rows, cols] with cols = trailing dim (or 1-D -> [1, n]):
+    keeps the lane dim large for the VPU without reshuffling memory."""
+    if x.ndim == 0:
+        return x.reshape(1, 1)
+    if x.ndim == 1:
+        return x.reshape(1, -1)
+    return x.reshape(-1, x.shape[-1])
+
+
+def _fused_leaf_update(g, p, mu, nu, scalars, b1, b2, eps,
+                       block_rows=256, block_cols=512):
+    """Run the kernel over one leaf of any shape."""
+    import functools
+
+    shape = p.shape
+    g2, p2, mu2, nu2 = (_as_2d(a) for a in (g, p, mu, nu))
+    r, c = p2.shape
+    br, bc = min(block_rows, r), min(block_cols, c)
+    grid = (pl.cdiv(r, br), pl.cdiv(c, bc))
+    spec = pl.BlockSpec((br, bc), lambda i, j: (i, j))
+    # [1, 4] block == the whole scalar array (lane dim equal to the full
+    # array dim satisfies the tiling rule)
+    scalar_spec = pl.BlockSpec((1, 4), lambda i, j: (0, 0))
+    new_p, new_mu, new_nu = pl.pallas_call(
+        functools.partial(_adamw_kernel, b1=b1, b2=b2, eps=eps),
+        grid=grid,
+        in_specs=[spec, spec, spec, spec, scalar_spec],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p.dtype),
+                   jax.ShapeDtypeStruct(mu2.shape, mu.dtype),
+                   jax.ShapeDtypeStruct(nu2.shape, nu.dtype)],
+        interpret=_use_interpret(),
+    )(g2, p2, mu2, nu2, scalars)
+    return (new_p.reshape(shape), new_mu.reshape(shape),
+            new_nu.reshape(shape))
+
+
+def fused_adamw(learning_rate: float | Callable[[jax.Array], jax.Array],
+                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """AdamW with a single-pass Pallas update kernel.
+
+    Drop-in for ``optax.adamw`` semantics (same recurrence, bias
+    correction, decoupled weight decay). The returned transformation also
+    carries ``fused_apply(grads, state, params) -> (new_params,
+    new_state)`` which the train step prefers — the plain ``update`` path
+    exists for optax-contract compatibility but costs one extra O(params)
+    pass to materialise deltas.
+    """
+
+    def _scalars(count):
+        t = count.astype(jnp.float32) + 1.0
+        lr = (learning_rate(count) if callable(learning_rate)
+              else jnp.asarray(learning_rate))
+        return jnp.stack([
+            jnp.asarray(lr, jnp.float32),
+            jnp.float32(weight_decay),
+            1.0 / (1.0 - jnp.float32(b1) ** t),
+            1.0 / (1.0 - jnp.float32(b2) ** t),
+        ]).reshape(1, 4)
+
+    def init(params):
+        # jax arrays are immutable: mu and nu can share the zeros tree
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return FusedAdamWState(count=jnp.zeros((), jnp.int32),
+                               mu=zeros, nu=zeros)
+
+    def fused_apply(grads, state, params):
+        sc = _scalars(state.count)
+        # single traversal, then rebuild the three result trees from the
+        # flat leaf list (no is_leaf-on-tuple heuristic, which would
+        # mis-slice a params pytree that used tuples as containers)
+        leaves, treedef = jax.tree.flatten(params)
+        g_l = treedef.flatten_up_to(grads)
+        m_l = treedef.flatten_up_to(state.mu)
+        v_l = treedef.flatten_up_to(state.nu)
+        outs = [_fused_leaf_update(g, p, m, v, sc, b1, b2, eps)
+                for g, p, m, v in zip(g_l, leaves, m_l, v_l)]
+        new_params = jax.tree.unflatten(treedef, [o[0] for o in outs])
+        new_mu = jax.tree.unflatten(treedef, [o[1] for o in outs])
+        new_nu = jax.tree.unflatten(treedef, [o[2] for o in outs])
+        return new_params, FusedAdamWState(
+            count=optax.safe_increment(state.count),
+            mu=new_mu, nu=new_nu)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adamw requires params")
+        new_params, new_state = fused_apply(grads, state, params)
+        updates = jax.tree.map(jnp.subtract, new_params, params)
+        return updates, new_state
+
+    # attach the fused path (GradientTransformation is a NamedTuple —
+    # subclass to carry the extra method). The alias exists because a name
+    # ASSIGNED in a class body resolves against class-then-global scope on
+    # the right-hand side, never the enclosing function.
+    _impl = fused_apply
+
+    class _Fused(optax.GradientTransformation):
+        fused_apply = staticmethod(_impl)
+
+    return _Fused(init, update)

@@ -71,6 +71,16 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     functions are jit-compiled; train_step donates the state buffers.
     """
     strategy = strategy or DataParallel()
+    fused_opt = hasattr(tx, "fused_apply")
+    if fused_opt and not isinstance(strategy, DataParallel):
+        # a pallas custom call is opaque to the GSPMD partitioner: under a
+        # sharded parameter layout XLA would replicate (all-gather) every
+        # leaf into the kernel, silently defeating FSDP/TP memory savings
+        # or OOMing — refuse loudly instead
+        raise ValueError(
+            "fused optimizers (adamw_fused) support replicated parameters "
+            "(DataParallel) only; use --optimizer adamw with sharded "
+            "parameter layouts")
 
     def _cast(x):
         if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
@@ -148,8 +158,16 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         with use_mesh(mesh):
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if fused_opt:
+            # single-pass fused optimizers produce new params directly —
+            # the update->apply_updates contract would cost one extra
+            # O(params) pass just to materialise deltas
+            new_params, new_opt_state = tx.fused_apply(
+                grads, state.opt_state, state.params)
+        else:
+            updates, new_opt_state = tx.update(grads, state.opt_state,
+                                               state.params)
+            new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1, params=new_params,
             model_state=new_mstate, opt_state=new_opt_state)
