@@ -31,7 +31,8 @@ def main(argv=None):
         rc = supervise(["-m", "distributed_compute_pytorch_tpu.cli", *child],
                        max_restarts=config.max_restarts,
                        heartbeat_path=config.heartbeat_path,
-                       heartbeat_timeout=config.heartbeat_timeout)
+                       heartbeat_timeout=config.heartbeat_timeout,
+                       first_beat_timeout=config.first_beat_timeout)
         sys.exit(rc)
     trainer = Trainer(config)
     result = trainer.fit()

@@ -77,6 +77,8 @@ class Config:
     supervise: bool = False        # run under the restart supervisor
     max_restarts: int = 3          # supervisor restart budget
     heartbeat_timeout: float = 300.0   # supervisor hang detection threshold (s)
+    first_beat_timeout: float | None = None  # hang-before-first-beat window
+                                             # (None = off; size for compiles)
     fault_at_step: int | None = None   # fault injection: trip at global step N
     fault_mode: str = "raise"      # 'raise' (crash) | 'hang' (stuck collective
                                    # stand-in); first incarnation only
@@ -177,6 +179,10 @@ class Config:
                        help="run under the restart supervisor (auto --resume "
                             "after crash/hang/preemption)")
         p.add_argument("--max_restarts", type=int, default=cls.max_restarts)
+        p.add_argument("--first_beat_timeout", type=float, default=None,
+                       help="supervisor: kill a child that never produces "
+                            "its FIRST heartbeat within this window (off by "
+                            "default; size generously for cold compiles)")
         p.add_argument("--heartbeat_timeout", type=float,
                        default=cls.heartbeat_timeout)
         p.add_argument("--fault_at_step", type=int, default=None,

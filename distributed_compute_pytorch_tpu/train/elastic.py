@@ -138,6 +138,7 @@ class Heartbeat:
 def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,
               heartbeat_path: str | None = None,
               heartbeat_timeout: float = 300.0,
+              first_beat_timeout: float | None = None,
               poll_interval: float = 0.5,
               kill_grace: float = 10.0) -> int:
     """Run ``child_argv`` under restart supervision; returns the exit code.
@@ -149,14 +150,22 @@ def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,
     budget; clean preemptions (:data:`EXIT_PREEMPTED` — checkpointed,
     transient by definition) restart for free, so a preemptible pool can
     bounce the run indefinitely. ``DCP_RESTART_COUNT`` tells each
-    incarnation which attempt it is. Staleness is only judged once *this*
-    child has beaten at least once, so XLA compiles before the first step
-    don't count as hangs (a hang before the first beat is therefore
-    undetectable — set ``heartbeat_timeout`` to cover eval passes, during
-    which the trainer also beats). SIGTERM/SIGINT to the supervisor forward
-    to the child (which preempt-checkpoints) and end supervision with the
-    child's exit code instead of restarting.
+    incarnation which attempt it is.
+
+    Staleness is only judged once *this* child has beaten at least once,
+    so XLA compiles before the first step don't count as hangs. A hang
+    BEFORE the first beat is covered separately by ``first_beat_timeout``
+    (None = disabled): if set, a child that hasn't produced its first
+    fresh beat within that window is treated as hung — size it generously
+    to cover worst-case cold compiles. Set ``heartbeat_timeout`` to cover
+    eval passes, during which the trainer also beats. SIGTERM/SIGINT to
+    the supervisor forward to the child (which preempt-checkpoints) and
+    end supervision with the child's exit code instead of restarting.
     """
+    if heartbeat_path is None and first_beat_timeout is not None:
+        print("[supervise] WARNING: first_beat_timeout has no effect "
+              "without a heartbeat_path — hang detection is DISABLED",
+              file=sys.stderr, flush=True)
     argv = [sys.executable, *child_argv]
     restarts = 0      # failures only; clean preemptions restart for free
     attempt = 0
@@ -181,8 +190,24 @@ def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,
                 cmd.append("--resume")
             child["proc"] = proc = subprocess.Popen(cmd, env=env)
             hung = False
+            started = time.monotonic()   # local elapsed time: immune to
+                                         # NTP clock steps (unlike hb["ts"],
+                                         # which must stay wall-clock)
             baseline = (Heartbeat.read(heartbeat_path)
                         if heartbeat_path else None)
+
+            def _kill_hung(why: str):
+                nonlocal hung
+                hung = True
+                print(f"[supervise] {why}; killing child",
+                      file=sys.stderr, flush=True)
+                proc.terminate()
+                try:
+                    return proc.wait(timeout=kill_grace)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    return proc.wait()
+
             while True:
                 rc = proc.poll()
                 if rc is not None:
@@ -191,16 +216,15 @@ def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,
                     hb = Heartbeat.read(heartbeat_path)
                     fresh = hb is not None and hb != baseline
                     if fresh and (time.time() - hb["ts"]) > heartbeat_timeout:
-                        hung = True
-                        print(f"[supervise] heartbeat stale "
-                              f"(> {heartbeat_timeout:.0f}s); killing child",
-                              file=sys.stderr, flush=True)
-                        proc.terminate()
-                        try:
-                            rc = proc.wait(timeout=kill_grace)
-                        except subprocess.TimeoutExpired:
-                            proc.kill()
-                            rc = proc.wait()
+                        rc = _kill_hung(f"heartbeat stale "
+                                        f"(> {heartbeat_timeout:.0f}s)")
+                        break
+                    if (not fresh and first_beat_timeout is not None
+                            and time.monotonic() - started
+                            > first_beat_timeout):
+                        rc = _kill_hung(
+                            f"no first heartbeat within "
+                            f"{first_beat_timeout:.0f}s")
                         break
                 time.sleep(poll_interval)
             attempt += 1

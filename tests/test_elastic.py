@@ -421,6 +421,39 @@ def test_supervise_hang_kill_consumes_budget_even_if_preempt_exit(tmp_path):
     assert time.time() - t0 < 60
 
 
+def test_supervise_first_beat_timeout_kills_silent_child(tmp_path):
+    """A child that hangs BEFORE its first heartbeat (the previously
+    documented blind spot) is killed once first_beat_timeout elapses."""
+    hb = tmp_path / "hb.json"
+    script = tmp_path / "never_beats.py"
+    script.write_text("import time\ntime.sleep(300)\n")
+    t0 = time.time()
+    rc = supervise([str(script)], max_restarts=0, heartbeat_path=str(hb),
+                   heartbeat_timeout=600.0, first_beat_timeout=1.0,
+                   poll_interval=0.05, kill_grace=2.0)
+    assert rc != 0
+    assert time.time() - t0 < 60
+
+
+def test_supervise_first_beat_timeout_tolerates_slow_start(tmp_path):
+    """A child that beats within the window is NOT killed — even when it
+    then runs well PAST the window (the timer must disarm on the first
+    fresh beat, not keep counting)."""
+    hb = tmp_path / "hb.json"
+    script = tmp_path / "slow_start.py"
+    script.write_text(
+        "import json, sys, time\n"
+        "time.sleep(1.0)\n"                      # 'compile', inside window
+        f"json.dump({{'ts': time.time(), 'epoch': 0, 'step': 0}}, "
+        f"open({str(hb)!r}, 'w'))\n"
+        "time.sleep(6.0)\n"                      # outlive the 5s window
+        "sys.exit(0)\n")
+    rc = supervise([str(script)], max_restarts=0, heartbeat_path=str(hb),
+                   heartbeat_timeout=600.0, first_beat_timeout=5.0,
+                   poll_interval=0.05)
+    assert rc == 0
+
+
 def test_supervise_passes_restart_count(tmp_path):
     """The child sees DCP_RESTART_COUNT so fault injection only trips once."""
     marker = tmp_path / "counts.txt"
