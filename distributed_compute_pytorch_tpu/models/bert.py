@@ -116,14 +116,12 @@ class BertMLM:
         mesh = current_mesh()
         if (mesh is not None and "pipe" in mesh.axis_names
                 and mesh.shape["pipe"] > 1):
-            if kv_mask is not None:
-                raise NotImplementedError(
-                    "padding masks under pipeline parallelism need the mask "
-                    "microbatched alongside x; set pad_token_id=None or "
-                    "run without a pipe axis")
+            # the pipeline microbatches the mask alongside x; each stage
+            # reads the slice of the microbatch it currently holds
             x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
                                 num_microbatches=c.pipeline_microbatches,
-                                rng=layers_rng, train=train, remat=c.remat)
+                                rng=layers_rng, train=train, remat=c.remat,
+                                kv_mask=kv_mask)
         else:
             def block_apply(p, h, rng=None, train=False):
                 return block.apply(p, h, rng=rng, train=train,

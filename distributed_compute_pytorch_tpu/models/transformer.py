@@ -27,7 +27,8 @@ from distributed_compute_pytorch_tpu.ops import attention as A
 def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
                        seq_axis: str = "seq", attn_impl: str = "auto",
                        dropout_rate: float = 0.0, rng=None,
-                       train: bool = False, kv_mask=None):
+                       train: bool = False, kv_mask=None,
+                       manual_axes: tuple = ()):
     """Fused-QKV multi-head attention + output projection + dropout.
 
     The shared attention half of every transformer variant (dense blocks
@@ -38,11 +39,17 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     ``kv_mask``: optional ``[batch, seq]`` key-validity (padding) mask —
     True = attend; honoured by all three paths (flash / dense / ring).
 
+    ``manual_axes``: mesh axes the CALLER is already manual over (the
+    pipeline's shard_map region, ``parallel/pipeline.py``). When it
+    includes ``seq_axis``, ``x`` is a local seq chunk and the ring runs
+    directly via ``ring_attention_manual`` — a nested shard_map cannot sit
+    inside a manual region.
+
     ``params``: ``{"qkv": Dense(d, 3d), "attn_out": Dense(d, d)}`` trees.
     """
     from distributed_compute_pytorch_tpu.core.mesh import current_mesh
     from distributed_compute_pytorch_tpu.parallel.ring_attention import (
-        ring_attention)
+        ring_attention, ring_attention_manual)
 
     d = x.shape[-1]
     qkv = L.Dense(d, 3 * d).apply(params["qkv"], x)
@@ -51,8 +58,14 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     k = A.split_heads(k, num_heads)
     v = A.split_heads(v, num_heads)
     mesh = current_mesh()
-    if (mesh is not None and seq_axis in mesh.axis_names
-            and mesh.shape[seq_axis] > 1):
+    seq_sharded = (mesh is not None and seq_axis in mesh.axis_names
+                   and mesh.shape[seq_axis] > 1)
+    if seq_sharded and seq_axis in manual_axes:
+        # already inside a manual region (pipeline stage): local ring
+        o = ring_attention_manual(q, k, v, seq_axis, mesh.shape[seq_axis],
+                                  causal=causal, kv_mask=kv_mask,
+                                  vary=manual_axes)
+    elif seq_sharded:
         # sequence-parallel path: K/V ring over the seq axis
         o = ring_attention(q, k, v, mesh, seq_axis, causal=causal,
                            kv_mask=kv_mask)
@@ -92,12 +105,12 @@ class TransformerBlock:
             "mlp_out": L.Dense(self.d_ff, d, param_dtype=pd).init(ks[3]),
         }
 
-    def _attn(self, params, x, rng, train, kv_mask=None):
+    def _attn(self, params, x, rng, train, kv_mask=None, manual_axes=()):
         return attention_sublayer(
             params, x, num_heads=self.num_heads, causal=self.causal,
             seq_axis=self.seq_axis, attn_impl=self.attn_impl,
             dropout_rate=self.dropout_rate, rng=rng, train=train,
-            kv_mask=kv_mask)
+            kv_mask=kv_mask, manual_axes=manual_axes)
 
     def _mlp(self, params, x, rng, train):
         h = L.Dense(self.d_model, self.d_ff).apply(params["mlp_in"], x)
@@ -106,7 +119,7 @@ class TransformerBlock:
         return L.dropout(h, self.dropout_rate, rng, train)
 
     def apply(self, params, x, *, rng=None, train: bool = False,
-              kv_mask=None):
+              kv_mask=None, manual_axes=()):
         r1 = r2 = None
         if train and rng is not None:
             r1, r2 = jax.random.split(rng)
@@ -114,11 +127,12 @@ class TransformerBlock:
         ln2 = L.LayerNorm(self.d_model)
         if self.pre_ln:
             x = x + self._attn(params, ln1.apply(params["ln1"], x), r1,
-                               train, kv_mask)
+                               train, kv_mask, manual_axes)
             x = x + self._mlp(params, ln2.apply(params["ln2"], x), r2, train)
         else:  # post-LN (BERT)
             x = ln1.apply(params["ln1"],
-                          x + self._attn(params, x, r1, train, kv_mask))
+                          x + self._attn(params, x, r1, train, kv_mask,
+                                         manual_axes))
             x = ln2.apply(params["ln2"], x + self._mlp(params, x, r2, train))
         return x
 

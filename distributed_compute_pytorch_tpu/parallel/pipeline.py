@@ -43,6 +43,7 @@ that no longer scales with the full per-block activation footprint;
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 
 import jax
@@ -119,14 +120,36 @@ def scan_blocks(block_apply, stacked_params, x, *, rng=None,
     return h
 
 
+def _block_extra_kwargs(block_apply) -> frozenset:
+    """Which of the optional pipeline kwargs ``block_apply`` can take.
+
+    Toy/test blocks keep the minimal ``(p, h, rng, train)`` signature;
+    transformer blocks additionally accept ``kv_mask`` (padding mask) and
+    ``manual_axes`` (so their attention knows it runs inside the pipeline's
+    manual region). Detected once per call, outside the traced region.
+    """
+    try:
+        sig = inspect.signature(block_apply)
+    except (TypeError, ValueError):   # builtins/partials without signature
+        return frozenset()
+    params = sig.parameters.values()
+    if any(p.kind == p.VAR_KEYWORD for p in params):
+        return frozenset({"kv_mask", "manual_axes"})
+    return frozenset(n for n in ("kv_mask", "manual_axes")
+                     if n in sig.parameters)
+
+
 def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
                     axis: str = "pipe", *, num_microbatches: int | None = None,
                     rng=None, train: bool = False,
-                    remat: bool | str = False):
+                    remat: bool | str = False, kv_mask=None):
     """Run stacked layers as a GPipe pipeline over ``mesh``'s ``axis``.
 
     Args:
       block_apply: ``(layer_params, x, rng, train) -> x`` for ONE layer.
+        May optionally accept ``kv_mask`` (its microbatch's padding-mask
+        slice) and ``manual_axes`` (the axes this region is manual over) —
+        both passed only when the signature takes them.
       stacked_params: pytree with leading ``[L, ...]`` leaves; ``L`` must be
         divisible by the pipe size ``P`` (each stage owns ``L/P`` layers).
         Shard dim 0 over ``pipe`` (see ``transformer.tp_partition_rules``).
@@ -137,24 +160,46 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         (checkpoint each block — residuals are block inputs), or
         ``"stage"`` (checkpoint each stage tick — residuals are stage
         inputs only, the 1F1B memory profile; see module docstring).
+      kv_mask: optional ``[B, T]`` key-validity mask, microbatched alongside
+        ``x``; each stage reads the slice of the microbatch it holds.
+
+    When the mesh also carries a ``seq`` axis > 1, the region goes manual
+    over BOTH ``pipe`` and ``seq``: activations are seq-split, the mask
+    slice is a local chunk, and the block's attention runs the ring
+    directly (``ring_attention_manual``) — pipe x seq composes.
 
     Returns activations ``[B, T, d]``, replicated over ``pipe`` (other mesh
-    axes keep their shardings — only ``pipe`` is manual here).
+    axes keep their shardings — only ``pipe``/``seq`` are manual here).
     """
     if remat not in (False, True, "block", "stage"):
         raise ValueError(f"remat must be False, True/'block' or 'stage', "
                          f"got {remat!r}")
+    extra = _block_extra_kwargs(block_apply)
+    if kv_mask is not None and "kv_mask" not in extra:
+        # loud, not silently-unmasked attention: a (p, h, rng, train)-only
+        # adapter around a mask-capable block erases the kwarg
+        raise TypeError(
+            "kv_mask was given but block_apply's signature does not accept "
+            "a `kv_mask` kwarg — pass the block's own apply (e.g. "
+            "TransformerBlock.apply), not a signature-erasing wrapper.")
     P_size = mesh.shape[axis]
     if P_size == 1:
         # no pipe: stage remat degrades to block remat (the only stage is
         # the whole stack; per-block is the strictly better grain there)
+        if kv_mask is not None:
+            inner = block_apply
+            block_apply = (lambda p, h, rng=None, train=False:
+                           inner(p, h, rng=rng, train=train, kv_mask=kv_mask))
         return scan_blocks(block_apply, stacked_params, x, rng=rng,
                            train=train, remat=bool(remat))
-    if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+    seq_manual = "seq" in mesh.axis_names and mesh.shape["seq"] > 1
+    if seq_manual and "manual_axes" not in extra:
         raise NotImplementedError(
-            "pipe and seq axes cannot be combined yet: ring attention nests "
-            "its own shard_map, which cannot sit inside the pipeline's "
-            "manual pipe region. Use pipe with data/fsdp/tensor.")
+            "this mesh combines pipe and seq, so block_apply must run its "
+            "attention manually over the seq axis — give it a "
+            "`manual_axes` kwarg wired to attention_sublayer (see "
+            "models/transformer.py) or drop one of the axes.")
+    manual = (axis, "seq") if seq_manual else (axis,)
     L = num_layers(stacked_params)
     if L % P_size:
         raise ValueError(f"{L} layers not divisible by pipe={P_size}")
@@ -165,18 +210,32 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     L_local = L // P_size
     mb = B // M
     perm = [(i, (i + 1) % P_size) for i in range(P_size)]
+    masked = kv_mask is not None   # signature validated above
 
-    apply = (remat_wrap(block_apply) if remat in (True, "block")
-             else block_apply)
+    def call_block(p, h, r, mk):
+        kw = {}
+        if masked:
+            kw["kv_mask"] = mk
+        if "manual_axes" in extra:
+            kw["manual_axes"] = manual
+        return block_apply(p, h, rng=r, train=train, **kw)
 
-    def stage_fn(params_local, h, stage, mb_id):
+    if remat in (True, "block"):
+        # per-block remat (see remat_wrap): only traced args reach the
+        # checkpoint — train/manual_axes stay closed-over statics
+        call_block = jax.checkpoint(call_block, prevent_cse=False)
+
+    def stage_fn(params_local, h, mk, stage, mb_id):
         def layer_body(h, scanned):
             i, p = scanned
             r = None
             if rng is not None and train:
                 g = stage * L_local + i          # global layer index
                 r = jax.random.fold_in(jax.random.fold_in(rng, g), mb_id)
-            return apply(p, h, rng=r, train=train), None
+                if seq_manual:
+                    # independent dropout bits per seq chunk
+                    r = jax.random.fold_in(r, lax.axis_index("seq"))
+            return call_block(p, h, r, mk), None
         h, _ = lax.scan(layer_body, h, (jnp.arange(L_local), params_local))
         return h
 
@@ -186,16 +245,26 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         # recomputed when its backward tick runs
         stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
 
+    # activations (and the mask) are replicated over pipe; under pipe x seq
+    # their T dim is additionally seq-split so the ring's chunks line up
+    x_spec = P(None, None, "seq", None) if seq_manual else P()
+    m_spec = P(None, None, "seq") if seq_manual else P()
+    in_specs = (P(axis), x_spec) + ((m_spec,) if masked else ())
+
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P(axis), P()), out_specs=P(),
-             axis_names={axis})
-    def _pipe(params_local, x_mb):
-        # params_local leaves: [L_local, ...]; x_mb: [M, mb, T, d] (global
-        # w.r.t. every auto axis, replicated over pipe)
+             in_specs=in_specs, out_specs=x_spec,
+             axis_names=set(manual))
+    def _pipe(params_local, x_mb, *maybe_mask):
+        # params_local leaves: [L_local, ...]; x_mb: [M, mb, T(/seq), d]
+        # (global w.r.t. every auto axis, replicated over pipe)
+        mask_mb = maybe_mask[0] if masked else None
         stage = lax.axis_index(axis)
-        state = lax.pcast(jnp.zeros(x_mb.shape[1:], x_mb.dtype), (axis,),
+        # fresh zeros (NOT zeros_like: that inherits x_mb's varying-over-seq
+        # type, and pcast rejects mixed varying/invarying inputs)
+        state = lax.pcast(jnp.zeros(x_mb.shape[1:], x_mb.dtype), manual,
                           to="varying")
-        outputs = lax.pcast(jnp.zeros_like(x_mb), (axis,), to="varying")
+        outputs = lax.pcast(jnp.zeros(x_mb.shape, x_mb.dtype), manual,
+                            to="varying")
 
         def tick(carry, t):
             state, outputs = carry
@@ -203,7 +272,8 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             # data whose outputs never reach a valid output slot)
             inp = jnp.where(stage == 0, x_mb[t % M], state)
             mb_id = (t - stage) % M              # microbatch this stage holds
-            y = stage_fn(params_local, inp, stage, mb_id)
+            mk = mask_mb[mb_id] if masked else None
+            y = stage_fn(params_local, inp, mk, stage, mb_id)
             # the last stage finished microbatch t-(P-1) this tick; earlier
             # (t < P-1) writes land on slots that valid later ticks rewrite
             out_idx = (t - (P_size - 1)) % M
@@ -220,5 +290,8 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         return lax.psum(outputs, axis)
 
     x_mb = x.reshape(M, mb, *x.shape[1:])
-    y_mb = _pipe(stacked_params, x_mb)
+    args = (stacked_params, x_mb)
+    if masked:
+        args += (kv_mask.reshape(M, mb, *kv_mask.shape[1:]),)
+    y_mb = _pipe(*args)
     return y_mb.reshape(x.shape)

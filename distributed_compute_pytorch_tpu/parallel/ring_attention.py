@@ -57,6 +57,66 @@ def _block_attend(q, kb, vb, o, m, l, q_pos, k_pos, scale, causal,
     return o_new, m_new, l_new
 
 
+def ring_attention_manual(q, k, v, axis: str, n_chunks: int, *,
+                          causal: bool = False, scale: float | None = None,
+                          kv_mask=None, vary: tuple = ()):
+    """Ring-attention body for callers ALREADY inside a manual region.
+
+    The pipeline (``parallel/pipeline.py``) runs its stages inside a
+    ``shard_map`` that is manual over ``pipe`` (and, when the mesh carries
+    one, ``seq``) — a nested ``shard_map`` cannot sit inside that region,
+    but this body can: it is plain ``ppermute``/``axis_index`` code. This
+    is what lifts the former pipe-x-seq ``NotImplementedError``.
+
+    Args:
+      q, k, v: LOCAL blocks ``[b, h, t_local, d]`` (seq already split over
+        ``axis``).
+      n_chunks: ring size (``mesh.shape[axis]`` at trace time — callers
+        inside a manual region still know their mesh statically).
+      kv_mask: optional LOCAL ``[b, t_local]`` key-validity chunk; rotates
+        with its K/V block.
+      vary: every manual axis the inputs vary over (the online-softmax
+        carries must be pcast to match before mixing with them).
+    Returns the LOCAL attention output ``[b, h, t_local, d]``.
+    """
+    b, h, chunk, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    mk = None if kv_mask is None else kv_mask.astype(jnp.float32)
+    my_chunk = lax.axis_index(axis)
+    q_pos = my_chunk * chunk + jnp.arange(chunk)
+    vary = tuple(vary) or (axis,)
+    o = lax.pcast(jnp.zeros((b, h, chunk, d), jnp.float32), vary,
+                  to="varying")
+    m = lax.pcast(jnp.full((b, h, chunk), _NEG_INF, jnp.float32), vary,
+                  to="varying")
+    l = lax.pcast(jnp.zeros((b, h, chunk), jnp.float32), vary, to="varying")
+
+    # local block first (no communication), then permute-then-attend for
+    # the remaining n-1 blocks — exactly n-1 neighbour exchanges total.
+    o, m, l = _block_attend(q, k, v, o, m, l, q_pos, q_pos, scale,
+                            causal, mk)
+    perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
+
+    def body(carry, step):
+        o, m, l, kb, vb, mb = carry
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        if mb is not None:
+            mb = lax.ppermute(mb, axis, perm)
+        # after `step` rotations we hold the block that started on
+        # device (my_chunk - step) mod P
+        src = (my_chunk - step) % n_chunks
+        k_pos = src * chunk + jnp.arange(chunk)
+        o, m, l = _block_attend(q, kb, vb, o, m, l, q_pos, k_pos,
+                                scale, causal, mb)
+        return (o, m, l, kb, vb, mb), None
+
+    if n_chunks > 1:
+        (o, m, l, *_), _ = lax.scan(body, (o, m, l, k, v, mk),
+                                    jnp.arange(1, n_chunks))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
                    causal: bool = False, scale: float | None = None,
                    kv_mask=None):
@@ -71,7 +131,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
         around the ring with its K/V block.
     Returns the attention output with the same sharding as ``q``.
     """
-    *_, seq_len, head_dim = q.shape
+    head_dim = q.shape[-1]
     scale = (head_dim ** -0.5) if scale is None else scale
     n_chunks = mesh.shape[axis]
     if n_chunks == 1:
@@ -81,8 +141,6 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
                 else kv_mask[:, None, None, :].astype(bool))
         return dot_product_attention(q, k, v, causal=causal, scale=scale,
                                      mask=mask)
-    chunk = seq_len // n_chunks
-
     # batch/head dims keep whatever sharding they already have; we only
     # manage the seq dim explicitly. data/fsdp shard batch, tensor shards
     # heads — all compose because shard_map specs name only mesh axes that
@@ -92,7 +150,6 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
     head_axes = "tensor" if "tensor" in names else None
     spec = P(batch_axes, head_axes, axis, None)
 
-    perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
     vary = tuple(a for a in ((batch_axes or ()) + ((head_axes,)
                  if head_axes else ()) + (axis,)))
     mask_spec = P(batch_axes, axis)
@@ -106,39 +163,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
              out_specs=spec)
     def _ring(q, k, v, *maybe_mask):
         mk = maybe_mask[0] if masked else None
-        my_chunk = lax.axis_index(axis)
-        q_pos = my_chunk * chunk + jnp.arange(chunk)
-        b, h, t, d = q.shape
-        # carries must be typed as varying over every axis k/v vary over
-        o = lax.pcast(jnp.zeros((b, h, t, d), jnp.float32), vary,
-                      to="varying")
-        m = lax.pcast(jnp.full((b, h, t), _NEG_INF, jnp.float32), vary,
-                      to="varying")
-        l = lax.pcast(jnp.zeros((b, h, t), jnp.float32), vary,
-                      to="varying")
-
-        # local block first (no communication), then permute-then-attend for
-        # the remaining n-1 blocks — exactly n-1 neighbour exchanges total.
-        o, m, l = _block_attend(q, k, v, o, m, l, q_pos, q_pos, scale,
-                                causal, mk)
-
-        def body(carry, step):
-            o, m, l, kb, vb, mb = carry
-            kb = lax.ppermute(kb, axis, perm)
-            vb = lax.ppermute(vb, axis, perm)
-            if mb is not None:
-                mb = lax.ppermute(mb, axis, perm)
-            # after `step` rotations we hold the block that started on
-            # device (my_chunk - step) mod P
-            src = (my_chunk - step) % n_chunks
-            k_pos = src * chunk + jnp.arange(chunk)
-            o, m, l = _block_attend(q, kb, vb, o, m, l, q_pos, k_pos,
-                                    scale, causal, mb)
-            return (o, m, l, kb, vb, mb), None
-
-        if n_chunks > 1:
-            (o, m, l, *_), _ = lax.scan(body, (o, m, l, k, v, mk),
-                                        jnp.arange(1, n_chunks))
-        return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+        return ring_attention_manual(q, k, v, axis, n_chunks, causal=causal,
+                                     scale=scale, kv_mask=mk, vary=vary)
 
     return _ring(q, k, v, kv_mask) if masked else _ring(q, k, v)

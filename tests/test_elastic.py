@@ -441,15 +441,19 @@ def test_supervise_first_beat_timeout_tolerates_slow_start(tmp_path):
     fresh beat, not keep counting)."""
     hb = tmp_path / "hb.json"
     script = tmp_path / "slow_start.py"
+    # margins sized for a loaded CI box: the pre-beat 'compile' sleep is
+    # tiny next to the window (interpreter startup under load has been
+    # observed to eat multiple seconds), and outliving the window is
+    # measured from child start (0.3 + 11.0 > 10.0)
     script.write_text(
         "import json, sys, time\n"
-        "time.sleep(1.0)\n"                      # 'compile', inside window
+        "time.sleep(0.3)\n"                      # 'compile', inside window
         f"json.dump({{'ts': time.time(), 'epoch': 0, 'step': 0}}, "
         f"open({str(hb)!r}, 'w'))\n"
-        "time.sleep(6.0)\n"                      # outlive the 5s window
+        "time.sleep(11.0)\n"                     # outlive the 10s window
         "sys.exit(0)\n")
     rc = supervise([str(script)], max_restarts=0, heartbeat_path=str(hb),
-                   heartbeat_timeout=600.0, first_beat_timeout=5.0,
+                   heartbeat_timeout=600.0, first_beat_timeout=10.0,
                    poll_interval=0.05)
     assert rc == 0
 

@@ -181,11 +181,160 @@ def test_gpt2_pipeline_step_matches_dp(devices8):
     assert qkv.sharding.shard_shape(qkv.shape)[0] == 1
 
 
-def test_pipe_seq_combination_rejected(devices8):
+def test_pipe_seq_needs_manual_aware_block(devices8):
+    """A block without a ``manual_axes`` kwarg can't run under pipe x seq
+    (its attention would try to nest a shard_map); the error says so."""
     mesh = make_mesh("pipe=2,seq=4", devices=devices8)
     apply, params = _stacked_mlp(jax.random.key(0), L=4)
     with pytest.raises(NotImplementedError, match="pipe and seq"):
         pipeline_blocks(apply, params, jnp.zeros((4, 4, 16)), mesh)
+
+
+def test_pipeline_kv_mask_needs_mask_aware_block(devices8):
+    """A kv_mask handed to a block whose signature can't take it must fail
+    loudly — silently-unmasked attention is the one wrong outcome."""
+    mesh = make_mesh("pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0), L=4)
+    with pytest.raises(TypeError, match="kv_mask"):
+        pipeline_blocks(apply, params, jnp.zeros((4, 4, 16)), mesh,
+                        kv_mask=jnp.ones((4, 4)))
+
+
+def test_transformer_pipe_seq_matches_scan(devices8):
+    """pipe=2 x seq=2 (+data=2): a causal TransformerBlock stack through the
+    pipeline — ring attention running manually inside the pipe region —
+    equals the unsharded scan."""
+    from distributed_compute_pytorch_tpu.models.transformer import (
+        TransformerBlock)
+
+    block = TransformerBlock(d_model=32, num_heads=4, d_ff=64,
+                             dropout_rate=0.0, causal=True)
+    params = stacked_layers(
+        [block.init(jax.random.key(i)) for i in range(4)])
+    x = jax.random.normal(jax.random.key(9), (8, 16, 32)) * 0.3
+
+    ref = jax.jit(lambda p, x: scan_blocks(block.apply, p, x))(params, x)
+
+    mesh = make_mesh("data=2,pipe=2,seq=2", devices=devices8)
+    with use_mesh(mesh):
+        piped = jax.jit(lambda p, x: pipeline_blocks(
+            block.apply, p, x, mesh, num_microbatches=4))(params, x)
+    np.testing.assert_allclose(np.asarray(piped), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("remat", [False, "stage"])
+def test_transformer_pipe_masked_matches_scan(devices8, remat):
+    """Padding masks under the pipeline (VERDICT r2: formerly rejected):
+    the mask is microbatched alongside x and each stage reads its slice —
+    masked pipeline == masked scan, under pipe alone and pipe x seq, with
+    and without stage-level remat (the checkpointed stage_fn carries the
+    mask as a traced argument)."""
+    from distributed_compute_pytorch_tpu.models.transformer import (
+        TransformerBlock)
+
+    block = TransformerBlock(d_model=32, num_heads=4, d_ff=64,
+                             dropout_rate=0.0, causal=False)
+    params = stacked_layers(
+        [block.init(jax.random.key(i)) for i in range(4)])
+    x = jax.random.normal(jax.random.key(9), (8, 16, 32)) * 0.3
+    lengths = [16, 12, 9, 16, 4, 7, 16, 2]
+    kv_mask = jnp.asarray(
+        (np.arange(16)[None, :] < np.asarray(lengths)[:, None])
+        .astype(np.float32))
+
+    def masked_scan(p, x):
+        return scan_blocks(
+            lambda p, h, rng=None, train=False: block.apply(
+                p, h, rng=rng, train=train, kv_mask=kv_mask), p, x)
+
+    ref = jax.jit(masked_scan)(params, x)
+
+    for spec in ("data=2,pipe=4", "data=2,pipe=2,seq=2"):
+        mesh = make_mesh(spec, devices=devices8)
+        with use_mesh(mesh):
+            piped = jax.jit(lambda p, x: pipeline_blocks(
+                block.apply, p, x, mesh, num_microbatches=4,
+                kv_mask=kv_mask, remat=remat))(params, x)
+        np.testing.assert_allclose(np.asarray(piped), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5, err_msg=spec)
+
+
+def test_gpt2_pipe_seq_step_matches_dp(devices8):
+    """Full GPT-2 train steps on data=2,pipe=2,seq=2 == pure DP — all of
+    pipeline, ring attention, and grad sync composed in one program."""
+    data = synthetic_lm(32, seq_len=16, vocab=256, seed=4)
+    cfg = GPT2Config(vocab_size=256, max_seq_len=64, num_layers=4,
+                     num_heads=4, d_model=64, d_ff=128, dropout_rate=0.0)
+
+    def run(spec, strategy):
+        mesh = make_mesh(spec, devices=devices8)
+        model = GPT2(cfg)
+        feed = DeviceFeeder(data, mesh, 32, shuffle=False)
+        tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+        init_fn, train_step, eval_step = make_step_fns(model, tx, mesh,
+                                                       strategy)
+        state = init_fn(jax.random.key(0))
+        (x, y), = list(feed.epoch(0))
+        for _ in range(2):
+            state, m = train_step(state, x, y)
+        em = eval_step(state, x, y)
+        return (jax.device_get(state.params), float(m["loss"]),
+                float(em["loss_sum"]))
+
+    model = GPT2(cfg)
+    rules = ShardingRules(rules=model.partition_rules(),
+                          fallback=DataParallel())
+    p_ref, l_ref, e_ref = run("data=8", DataParallel())
+    p_ps, l_ps, e_ps = run("data=2,pipe=2,seq=2", rules)
+    np.testing.assert_allclose(l_ps, l_ref, rtol=2e-4)
+    np.testing.assert_allclose(e_ps, e_ref, rtol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_ps)):
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=3e-5)
+
+
+def test_bert_masked_pipeline_step_matches_dp(devices8):
+    """BERT with real padding under pipe=2 (and pipe=2 x seq=2): the
+    formerly-rejected combination now trains, matching pure DP."""
+    import dataclasses
+
+    from distributed_compute_pytorch_tpu.models.bert import (
+        BertConfig, BertMLM)
+
+    cfg = dataclasses.replace(BertConfig.tiny(), num_layers=2,
+                              dropout_rate=0.0, pad_token_id=0,
+                              mask_token_id=2)
+    rng = np.random.Generator(np.random.Philox(key=11))
+    toks = rng.integers(3, 256, size=(32, 16)).astype(np.int32)
+    lengths = rng.integers(4, 17, size=(32,))
+    toks = np.where(np.arange(16)[None, :] < lengths[:, None], toks, 0)
+    from distributed_compute_pytorch_tpu.data.datasets import ArrayDataset
+    data = ArrayDataset(toks, toks.copy(), name="padded-mlm")
+
+    def run(spec, strategy):
+        mesh = make_mesh(spec, devices=devices8)
+        model = BertMLM(cfg)
+        feed = DeviceFeeder(data, mesh, 32, shuffle=False)
+        tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+        init_fn, train_step, _ = make_step_fns(model, tx, mesh, strategy)
+        state = init_fn(jax.random.key(0))
+        (x, y), = list(feed.epoch(0))
+        for _ in range(2):
+            state, m = train_step(state, x, y)
+        return jax.device_get(state.params), float(m["loss"])
+
+    model = BertMLM(cfg)
+    rules = ShardingRules(rules=model.partition_rules(),
+                          fallback=DataParallel())
+    p_ref, l_ref = run("data=8", DataParallel())
+    for spec in ("data=4,pipe=2", "data=2,pipe=2,seq=2"):
+        p_pipe, l_pipe = run(spec, rules)
+        np.testing.assert_allclose(l_pipe, l_ref, rtol=2e-4, err_msg=spec)
+        for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                        jax.tree_util.tree_leaves(p_pipe)):
+            np.testing.assert_allclose(b, a, rtol=3e-4, atol=3e-5,
+                                       err_msg=spec)
 
 
 def test_trainer_mesh_spec_engages_pipeline(tmp_path):
