@@ -46,7 +46,7 @@ class Config:
                                    # "data=2,fsdp=4", "data=1,tensor=4,seq=2"; -1 = infer
 
     # --- model / task selection (the reference has one model; we have a zoo) ---
-    model: str = "convnet"         # convnet | resnet18 | resnet50 | bert | gpt2 | moe
+    model: str = "convnet"         # convnet | resnet18 | resnet50 | bert | gpt2 | moe | llama
     model_preset: str | None = None  # e.g. 'tiny' for test-scale transformers
     microbatches: int | None = None  # GPipe microbatches under a pipe axis
     dataset: str = "mnist"         # mnist | cifar10 | synthetic-images | synthetic-lm

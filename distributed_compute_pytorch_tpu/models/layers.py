@@ -223,6 +223,26 @@ class LayerNorm:
 
 
 @dataclass(frozen=True)
+class RMSNorm:
+    """Root-mean-square norm (no mean subtraction, no bias) — the Llama
+    family's normalisation. Stats in float32 regardless of activation
+    dtype (bf16 squares underflow), matching the HF reference numerics."""
+
+    num_features: int
+    eps: float = 1e-6
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        del key
+        return {"scale": jnp.ones((self.num_features,), self.param_dtype)}
+
+    def apply(self, params, x):
+        x32 = x.astype(jnp.float32)
+        y = x32 * lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + self.eps)
+        return (y * params["scale"].astype(jnp.float32)).astype(x.dtype)
+
+
+@dataclass(frozen=True)
 class Embedding:
     """Token/position embedding table."""
 

@@ -42,4 +42,8 @@ def build_model(name: str, **kw: Any):
             MoETransformerConfig, MoETransformerLM)
         return MoETransformerLM(_transformer_config(
             MoETransformerConfig, MoETransformerConfig(), kw))
+    if name == "llama":
+        from distributed_compute_pytorch_tpu.models.llama import (
+            LlamaConfig, LlamaLM)
+        return LlamaLM(_transformer_config(LlamaConfig, LlamaConfig(), kw))
     raise ValueError(f"unknown model {name!r}")

@@ -24,6 +24,45 @@ from distributed_compute_pytorch_tpu.models import layers as L
 from distributed_compute_pytorch_tpu.ops import attention as A
 
 
+def dispatch_attention(q, k, v, *, causal: bool = False,
+                       seq_axis: str = "seq", attn_impl: str = "auto",
+                       kv_mask=None, manual_axes: tuple = ()):
+    """Route split-head ``[B, H, T, hd]`` attention to the right engine.
+
+    One dispatcher for every model family: the Pallas flash kernel (or
+    dense XLA) when the mesh has no ``seq`` axis, shard_map ring attention
+    when it does, and the manual ring body when the caller is already
+    inside a manual region over ``seq`` (pipeline stages — a nested
+    shard_map cannot sit there).
+
+    GQA (``k``/``v`` with fewer heads than ``q``, grouped as head ``h`` ->
+    kv head ``h // G``) is handled per-engine: the ring paths consume the
+    narrow K/V directly — rotating pre-repeated heads would move ``G x``
+    the bytes over ICI — while the flash/dense kernels get an explicit
+    head repeat.
+    """
+    from distributed_compute_pytorch_tpu.core.mesh import current_mesh
+    from distributed_compute_pytorch_tpu.parallel.ring_attention import (
+        ring_attention, ring_attention_manual)
+
+    mesh = current_mesh()
+    seq_sharded = (mesh is not None and seq_axis in mesh.axis_names
+                   and mesh.shape[seq_axis] > 1)
+    if seq_sharded and seq_axis in manual_axes:
+        return ring_attention_manual(q, k, v, seq_axis,
+                                     mesh.shape[seq_axis], causal=causal,
+                                     kv_mask=kv_mask, vary=manual_axes)
+    if seq_sharded:
+        return ring_attention(q, k, v, mesh, seq_axis, causal=causal,
+                              kv_mask=kv_mask)
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return A.attention(q, k, v, causal=causal, impl=attn_impl,
+                       kv_mask=kv_mask)
+
+
 def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
                        seq_axis: str = "seq", attn_impl: str = "auto",
                        dropout_rate: float = 0.0, rng=None,
@@ -47,31 +86,15 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
 
     ``params``: ``{"qkv": Dense(d, 3d), "attn_out": Dense(d, d)}`` trees.
     """
-    from distributed_compute_pytorch_tpu.core.mesh import current_mesh
-    from distributed_compute_pytorch_tpu.parallel.ring_attention import (
-        ring_attention, ring_attention_manual)
-
     d = x.shape[-1]
     qkv = L.Dense(d, 3 * d).apply(params["qkv"], x)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = A.split_heads(q, num_heads)
     k = A.split_heads(k, num_heads)
     v = A.split_heads(v, num_heads)
-    mesh = current_mesh()
-    seq_sharded = (mesh is not None and seq_axis in mesh.axis_names
-                   and mesh.shape[seq_axis] > 1)
-    if seq_sharded and seq_axis in manual_axes:
-        # already inside a manual region (pipeline stage): local ring
-        o = ring_attention_manual(q, k, v, seq_axis, mesh.shape[seq_axis],
-                                  causal=causal, kv_mask=kv_mask,
-                                  vary=manual_axes)
-    elif seq_sharded:
-        # sequence-parallel path: K/V ring over the seq axis
-        o = ring_attention(q, k, v, mesh, seq_axis, causal=causal,
-                           kv_mask=kv_mask)
-    else:
-        o = A.attention(q, k, v, causal=causal, impl=attn_impl,
-                        kv_mask=kv_mask)
+    o = dispatch_attention(q, k, v, causal=causal, seq_axis=seq_axis,
+                           attn_impl=attn_impl, kv_mask=kv_mask,
+                           manual_axes=manual_axes)
     o = A.merge_heads(o)
     o = L.Dense(d, d).apply(params["attn_out"], o)
     return L.dropout(o, dropout_rate, rng, train)

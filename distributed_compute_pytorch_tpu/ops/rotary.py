@@ -1,0 +1,54 @@
+"""Rotary position embeddings (RoPE) — the Llama family's positional
+encoding.
+
+Capability beyond the reference (whose only model is a position-free CNN,
+``/root/reference/main.py:20-45``); needed for the modern decoder rung.
+Convention matches the open Llama implementations (half-split
+``rotate_half``, NOT interleaved pairs) so weights/numerics port 1:1.
+
+TPU notes: cos/sin are computed in float32 (bf16 phases lose precision at
+long context) and the rotation is two fused elementwise multiplies — XLA
+folds it into the surrounding matmul epilogue, so RoPE adds no HBM
+round-trip.
+
+Because rotations are absolute-position phases whose *differences* carry
+the relative offset, applying RoPE before K/V leave for a ring rotation
+(sequence parallelism) is exact: each chunk bakes its own global positions
+in, wherever it later travels (``parallel/ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions, head_dim: int, theta: float = 10000.0):
+    """``cos, sin`` tables ``[T, head_dim]`` for integer ``positions [T]``.
+
+    Frequencies follow ``theta ** (-2i/d)`` for the first ``d/2`` feature
+    pairs; each table duplicates its ``[T, d/2]`` half so the rotation is
+    a plain elementwise multiply against the half-split layout.
+    """
+    half = head_dim // 2
+    inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    cos = jnp.concatenate([jnp.cos(freqs), jnp.cos(freqs)], axis=-1)
+    sin = jnp.concatenate([jnp.sin(freqs), jnp.sin(freqs)], axis=-1)
+    return cos, sin
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate ``x [B, H, T, hd]`` by its positions ``[T]`` (int).
+
+    ``positions`` may be traced (the pipeline's seq-manual path offsets
+    them by ``axis_index('seq') * chunk``).
+    """
+    cos, sin = rope_cos_sin(positions, x.shape[-1], theta)
+    x32 = x.astype(jnp.float32)
+    out = x32 * cos[None, None] + _rotate_half(x32) * sin[None, None]
+    return out.astype(x.dtype)

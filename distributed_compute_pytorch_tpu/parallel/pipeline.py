@@ -127,14 +127,15 @@ def _block_extra_kwargs(block_apply) -> frozenset:
     transformer blocks additionally accept ``kv_mask`` (padding mask) and
     ``manual_axes`` (so their attention knows it runs inside the pipeline's
     manual region). Detected once per call, outside the traced region.
+
+    Only EXPLICIT named parameters count: a ``**kwargs`` catch-all would
+    accept-and-discard ``kv_mask``, silently running attention unmasked —
+    wrappers must name the kwargs they actually forward.
     """
     try:
         sig = inspect.signature(block_apply)
     except (TypeError, ValueError):   # builtins/partials without signature
         return frozenset()
-    params = sig.parameters.values()
-    if any(p.kind == p.VAR_KEYWORD for p in params):
-        return frozenset({"kv_mask", "manual_axes"})
     return frozenset(n for n in ("kv_mask", "manual_axes")
                      if n in sig.parameters)
 

@@ -77,23 +77,41 @@ def ring_attention_manual(q, k, v, axis: str, n_chunks: int, *,
         with its K/V block.
       vary: every manual axis the inputs vary over (the online-softmax
         carries must be pcast to match before mixing with them).
-    Returns the LOCAL attention output ``[b, h, t_local, d]``.
+
+    GQA: ``q`` may carry ``G x`` more heads than ``k``/``v`` (query head
+    ``h`` reads kv head ``h // G``). The group dim is folded into q's
+    sequence dim (positions tiled to match) so the ring rotates ONLY the
+    true kv heads — a ``jnp.repeat`` before the ring would move ``G x``
+    the bytes over ICI and hold ``G x`` the K/V block memory per chip.
+
+    Returns the LOCAL attention output ``[b, h_q, t_local, d]``.
     """
-    b, h, chunk, d = q.shape
+    b, hq, chunk, d = q.shape
+    hk = k.shape[1]
+    assert hq % hk == 0, (hq, hk)
+    groups = hq // hk
     scale = (d ** -0.5) if scale is None else scale
     mk = None if kv_mask is None else kv_mask.astype(jnp.float32)
     my_chunk = lax.axis_index(axis)
-    q_pos = my_chunk * chunk + jnp.arange(chunk)
+    my_pos = my_chunk * chunk + jnp.arange(chunk)   # this device's chunk
+    q_pos = my_pos
+    if groups > 1:
+        # [b, hk*G, t, d] -> [b, hk, G*t, d]: query head kv*G+g lands at
+        # group-sequence slot g*t+i of kv head kv, positions tiled to
+        # match; KEY positions stay chunk-length (K/V are not folded)
+        q = q.reshape(b, hk, groups * chunk, d)
+        q_pos = jnp.tile(q_pos, groups)
+    tq = q.shape[2]            # group-folded query length (G * chunk)
     vary = tuple(vary) or (axis,)
-    o = lax.pcast(jnp.zeros((b, h, chunk, d), jnp.float32), vary,
+    o = lax.pcast(jnp.zeros((b, hk, tq, d), jnp.float32), vary,
                   to="varying")
-    m = lax.pcast(jnp.full((b, h, chunk), _NEG_INF, jnp.float32), vary,
+    m = lax.pcast(jnp.full((b, hk, tq), _NEG_INF, jnp.float32), vary,
                   to="varying")
-    l = lax.pcast(jnp.zeros((b, h, chunk), jnp.float32), vary, to="varying")
+    l = lax.pcast(jnp.zeros((b, hk, tq), jnp.float32), vary, to="varying")
 
     # local block first (no communication), then permute-then-attend for
     # the remaining n-1 blocks — exactly n-1 neighbour exchanges total.
-    o, m, l = _block_attend(q, k, v, o, m, l, q_pos, q_pos, scale,
+    o, m, l = _block_attend(q, k, v, o, m, l, q_pos, my_pos, scale,
                             causal, mk)
     perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
 
@@ -114,7 +132,10 @@ def ring_attention_manual(q, k, v, axis: str, n_chunks: int, *,
     if n_chunks > 1:
         (o, m, l, *_), _ = lax.scan(body, (o, m, l, k, v, mk),
                                     jnp.arange(1, n_chunks))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    if groups > 1:
+        out = out.reshape(b, hq, chunk, d)   # unfold the group dim
+    return out
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
@@ -137,6 +158,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
     if n_chunks == 1:
         from distributed_compute_pytorch_tpu.ops.attention import (
             dot_product_attention)
+        if k.shape[1] != q.shape[1]:   # GQA: dense path needs full heads
+            rep = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         mask = (None if kv_mask is None
                 else kv_mask[:, None, None, :].astype(bool))
         return dot_product_attention(q, k, v, causal=causal, scale=scale,

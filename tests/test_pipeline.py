@@ -223,7 +223,7 @@ def test_transformer_pipe_seq_matches_scan(devices8):
                                rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("remat", [False, "stage"])
+@pytest.mark.parametrize("remat", [False, "block", "stage"])
 def test_transformer_pipe_masked_matches_scan(devices8, remat):
     """Padding masks under the pipeline (VERDICT r2: formerly rejected):
     the mask is microbatched alongside x and each stage reads its slice —
