@@ -1,0 +1,139 @@
+"""Autoregressive KV-cache generation for the causal LMs (GPT-2, Llama).
+
+The reference is a training-only example (``/root/reference/main.py`` has
+no inference path at all); a complete framework needs one. TPU-idiomatic
+design: everything is ONE compiled program with static shapes —
+
+- **Prefill** runs the blocks' full-sequence forward over the prompt
+  (python loop over the static layer count, MXU-batched over positions),
+  capturing each layer's K/V into a preallocated ``[B, Hk, t_max, hd]``
+  cache (kv-head width: under GQA the cache and its bandwidth scale with
+  ``num_kv_heads``, not ``num_heads``).
+- **Decode** is a ``lax.scan`` over ``max_new_tokens`` ticks; each tick
+  embeds one token, runs every block's ``decode_step`` (cache write +
+  masked attention over slots ``0..pos``), and samples the next token.
+  No data-dependent python control flow, no per-token dispatch — the
+  whole generation is a single device program.
+
+Sampling: greedy at ``temperature=0`` else softmax sampling via
+``jax.random.categorical``; both deterministic given the rng key.
+
+Model contract (``gpt2.py``/``llama.py``): ``embed(params, tokens,
+positions)``, ``readout(params, x)``, ``kv_cache_spec()``, ``_block()``
+with ``apply(..., kv_sink=...)`` and ``decode_step(params, x, cache,
+pos)``. Correctness is pinned by ``tests/test_generate.py``: greedy
+cached generation must equal a full-forward re-run at every step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _per_layer(stacked, i: int):
+    return jax.tree.map(lambda a: a[i], stacked)
+
+
+def _num_layers(stacked) -> int:
+    return int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
+
+
+def prefill(model, params, prompt, t_max: int):
+    """Run the prompt through the blocks, filling fresh decode caches.
+
+    Returns ``(last_logits [B, vocab], caches)`` where ``caches`` is a
+    list of per-layer ``{"k","v"}: [B, Hk, t_max, hd]`` (prompt K/V
+    written at positions ``0..T0-1``, rest zeros).
+    """
+    B, T0 = prompt.shape
+    assert T0 <= t_max, (T0, t_max)
+    hk, hd = model.kv_cache_spec()
+    block = model._block()
+    x = model.embed(params, prompt, jnp.arange(T0))
+    dtype = x.dtype
+    caches = []
+    for i in range(_num_layers(params["blocks"])):
+        sink: list = []
+        x = block.apply(_per_layer(params["blocks"], i), x, kv_sink=sink)
+        (k, v), = sink
+        pad = lambda a: lax.dynamic_update_slice_in_dim(
+            jnp.zeros((B, hk, t_max, hd), dtype), a.astype(dtype), 0, axis=2)
+        caches.append({"k": pad(k), "v": pad(v)})
+    return model.readout(params, x)[:, -1], caches
+
+
+def _sample(logits, temperature: float, rng):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, logits.astype(jnp.float32) / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
+                     temperature: float = 0.0):
+    """Build a jitted ``(params, prompt [B, T0], rng) -> tokens
+    [B, T0 + max_new_tokens]`` generation function.
+
+    ``t_max`` caps the cache length (default ``T0 + max_new_tokens`` at
+    trace time); one compilation per (model, prompt-shape, max_new).
+    """
+    block = model._block()
+
+    @partial(jax.jit, static_argnames=("_tmax",))
+    def _generate(params, prompt, rng, _tmax):
+        B, T0 = prompt.shape
+        last_logits, caches = prefill(model, params, prompt, _tmax)
+        rng, sub = jax.random.split(rng)   # use-once keys: fresh half here
+        first = _sample(last_logits, temperature, sub)
+
+        def tick(carry, i):
+            tok, caches, rng = carry
+            pos = T0 + i                       # position being written
+            x = model.embed(params, tok[:, None], jnp.atleast_1d(pos))
+            new_caches = []
+            for li, c in enumerate(caches):
+                x, c2 = block.decode_step(
+                    _per_layer(params["blocks"], li), x, c, pos)
+                new_caches.append(c2)
+            logits = model.readout(params, x)[:, -1]
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits, temperature, sub)
+            return (nxt, new_caches, rng), tok
+
+        # tick i consumes the token at position T0+i and decides T0+i+1;
+        # the scan's stacked outputs are exactly the max_new_tokens new
+        # tokens (the final tick's decision would be token T0+N — unused)
+        _, toks = lax.scan(tick, (first, caches, rng),
+                           jnp.arange(max_new_tokens))
+        return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
+
+    def generate(params, prompt, rng=None):
+        rng = jax.random.key(0) if rng is None else rng
+        tm = t_max or (prompt.shape[1] + max_new_tokens)
+        if prompt.shape[1] + max_new_tokens > tm:
+            raise ValueError(
+                f"t_max={tm} can't hold prompt {prompt.shape[1]} + "
+                f"{max_new_tokens} new tokens")
+        model_cap = getattr(model.config, "max_seq_len", None)
+        if model_cap is not None and tm > model_cap:
+            # past this, learned position tables would be indexed out of
+            # range — and JAX gather CLAMPS instead of raising, so the
+            # output would be silently wrong
+            raise ValueError(
+                f"t_max={tm} exceeds the model's max_seq_len={model_cap}")
+        return _generate(params, prompt, rng, tm)
+
+    generate._jitted = _generate   # exposed for cache/retrace inspection
+    return generate
+
+
+def generate(model, params, prompt, max_new_tokens: int, *,
+             t_max: int | None = None, temperature: float = 0.0, rng=None):
+    """One-shot convenience wrapper around :func:`make_generate_fn`."""
+    return make_generate_fn(model, max_new_tokens, t_max=t_max,
+                            temperature=temperature)(params, prompt, rng)

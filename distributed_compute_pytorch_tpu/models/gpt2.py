@@ -94,14 +94,32 @@ class GPT2:
         }
         return params, {}   # no batch-stat state in transformers
 
+    def embed(self, params, tokens, positions=None):
+        """Token + learned-position embeddings; ``positions`` defaults to
+        ``arange(T)`` (decode passes the cache position, ``infer.py``)."""
+        c = self.config
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        return (L.Embedding(c.vocab_size, c.d_model).apply(params["wte"],
+                                                           tokens)
+                + L.Embedding(c.max_seq_len, c.d_model).apply(params["wpe"],
+                                                              positions))
+
+    def readout(self, params, x):
+        """Final LayerNorm + weight-tied readout."""
+        c = self.config
+        x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
+        return L.Embedding(c.vocab_size, c.d_model).attend(params["wte"], x)
+
+    def kv_cache_spec(self):
+        """(num_kv_heads, head_dim) a decode cache must hold per layer."""
+        c = self.config
+        return c.num_heads, c.d_model // c.num_heads
+
     def apply(self, params, state, tokens, *, train: bool = False, rng=None):
         """``tokens [B, T] int32`` -> logits ``[B, T, vocab]``."""
         c = self.config
-        wte = L.Embedding(c.vocab_size, c.d_model)
-        wpe = L.Embedding(c.max_seq_len, c.d_model)
-        T = tokens.shape[1]
-        pos = jnp.arange(T)
-        x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"], pos)
+        x = self.embed(params, tokens)
         layers_rng = None
         if train and rng is not None:
             emb_rng, layers_rng = jax.random.split(rng)
@@ -117,9 +135,7 @@ class GPT2:
             x = scan_blocks(block.apply, params["blocks"], x,
                             rng=layers_rng, train=train, remat=c.remat,
                             unroll=c.unroll_layers)
-        x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
-        logits = wte.attend(params["wte"], x)  # weight-tied readout
-        return logits, state
+        return self.readout(params, x), state
 
     # --- loss protocol (next-token prediction: shift inside) ---
 

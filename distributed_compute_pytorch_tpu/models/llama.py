@@ -103,23 +103,43 @@ class LlamaBlock:
             pos = pos + lax.axis_index("seq") * T
         return pos
 
-    def apply(self, params, x, *, rng=None, train: bool = False,
-              kv_mask=None, manual_axes=()):
-        del rng, train    # the Llama recipe has no dropout
+    def _qkv(self, params, h, positions):
+        """Projected + roped q/k/v (K/V at GQA kv-head width)."""
         c = self.config
         d, hd = c.d_model, c.head_dim
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
-
-        h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
         q = A.split_heads(dense(d, c.num_heads * hd).apply(params["q"], h),
                           c.num_heads)
         k = A.split_heads(dense(d, c.num_kv_heads * hd).apply(params["k"], h),
                           c.num_kv_heads)
         v = A.split_heads(dense(d, c.num_kv_heads * hd).apply(params["v"], h),
                           c.num_kv_heads)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+        return q, k, v
+
+    def _mlp(self, params, x):
+        c = self.config
+        dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
+        h = L.RMSNorm(c.d_model, c.rms_eps).apply(params["mlp_norm"], x)
+        gated = (jax.nn.silu(dense(c.d_model, c.d_ff).apply(params["gate"], h))
+                 * dense(c.d_model, c.d_ff).apply(params["up"], h))
+        return x + dense(c.d_ff, c.d_model).apply(params["down"], gated)
+
+    def apply(self, params, x, *, rng=None, train: bool = False,
+              kv_mask=None, manual_axes=(), kv_sink=None):
+        del rng, train    # the Llama recipe has no dropout
+        c = self.config
+        d, hd = c.d_model, c.head_dim
+        dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
+
+        h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
         pos = self._positions(x.shape[1], tuple(manual_axes))
-        q = apply_rope(q, pos, c.rope_theta)
-        k = apply_rope(k, pos, c.rope_theta)
+        q, k, v = self._qkv(params, h, pos)
+        if kv_sink is not None:
+            # prefill capture: post-rope, kv-head width — exactly what the
+            # decode cache stores
+            kv_sink.append((k, v))
         # GQA K/V stay at num_kv_heads width: the dispatcher repeats heads
         # only for the kernels that need it (ring paths rotate the narrow
         # K/V — see dispatch_attention)
@@ -127,11 +147,28 @@ class LlamaBlock:
                                manual_axes=manual_axes)
         x = x + dense(c.num_heads * hd, d).apply(params["o"],
                                                  A.merge_heads(o))
+        return self._mlp(params, x)
 
-        h = L.RMSNorm(d, c.rms_eps).apply(params["mlp_norm"], x)
-        gated = (jax.nn.silu(dense(d, c.d_ff).apply(params["gate"], h))
-                 * dense(d, c.d_ff).apply(params["up"], h))
-        return x + dense(c.d_ff, d).apply(params["down"], gated)
+    def decode_step(self, params, x, cache, pos):
+        """One KV-cached decode tick: ``x [B, 1, d]`` at position ``pos``.
+
+        The cache stays at kv-head width ([B, Hk, T_max, hd]) — GQA's
+        memory/bandwidth saving — and stores POST-rope keys, so each tick
+        rotates only its own position.
+        """
+        c = self.config
+        d, hd = c.d_model, c.head_dim
+        dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
+        h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
+        q, k, v = self._qkv(params, h, jnp.atleast_1d(pos))
+        cache = {"k": lax.dynamic_update_slice_in_dim(
+                     cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
+                 "v": lax.dynamic_update_slice_in_dim(
+                     cache["v"], v.astype(cache["v"].dtype), pos, axis=2)}
+        o = A.cached_attention(q, cache["k"], cache["v"], pos)
+        x = x + dense(c.num_heads * hd, d).apply(params["o"],
+                                                 A.merge_heads(o))
+        return self._mlp(params, x), cache
 
 
 @dataclass(frozen=True)
@@ -155,10 +192,29 @@ class LlamaLM:
                                param_dtype=c.param_dtype).init(ks[-1]),
         }, {}   # no batch-stat state
 
+    def embed(self, params, tokens, positions=None):
+        """Token embeddings (positions unused — RoPE lives in the blocks;
+        accepted for the shared decode protocol, ``infer.py``)."""
+        del positions
+        c = self.config
+        return L.Embedding(c.vocab_size, c.d_model).apply(params["wte"],
+                                                          tokens)
+
+    def readout(self, params, x):
+        """Final norm + untied LM head: ``[.., d]`` -> ``[.., vocab]``."""
+        c = self.config
+        x = L.RMSNorm(c.d_model, c.rms_eps).apply(params["norm_f"], x)
+        return L.Dense(c.d_model, c.vocab_size,
+                       use_bias=False).apply(params["lm_head"], x)
+
+    def kv_cache_spec(self):
+        """(num_kv_heads, head_dim) a decode cache must hold per layer."""
+        return self.config.num_kv_heads, self.config.head_dim
+
     def apply(self, params, state, tokens, *, train: bool = False, rng=None):
         """``tokens [B, T] int32`` -> logits ``[B, T, vocab]``."""
         c = self.config
-        x = L.Embedding(c.vocab_size, c.d_model).apply(params["wte"], tokens)
+        x = self.embed(params, tokens)
         block = self._block()
         mesh = current_mesh()
         if (mesh is not None and "pipe" in mesh.axis_names
@@ -170,10 +226,7 @@ class LlamaLM:
             x = scan_blocks(block.apply, params["blocks"], x,
                             rng=rng, train=train, remat=c.remat,
                             unroll=c.unroll_layers)
-        x = L.RMSNorm(c.d_model, c.rms_eps).apply(params["norm_f"], x)
-        logits = L.Dense(c.d_model, c.vocab_size,
-                         use_bias=False).apply(params["lm_head"], x)
-        return logits, state
+        return self.readout(params, x), state
 
     # --- loss protocol (next-token prediction, same as GPT-2) ---
 

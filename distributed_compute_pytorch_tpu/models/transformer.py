@@ -19,6 +19,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from distributed_compute_pytorch_tpu.models import layers as L
 from distributed_compute_pytorch_tpu.ops import attention as A
@@ -67,7 +68,7 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
                        seq_axis: str = "seq", attn_impl: str = "auto",
                        dropout_rate: float = 0.0, rng=None,
                        train: bool = False, kv_mask=None,
-                       manual_axes: tuple = ()):
+                       manual_axes: tuple = (), kv_sink: list | None = None):
     """Fused-QKV multi-head attention + output projection + dropout.
 
     The shared attention half of every transformer variant (dense blocks
@@ -92,6 +93,8 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     q = A.split_heads(q, num_heads)
     k = A.split_heads(k, num_heads)
     v = A.split_heads(v, num_heads)
+    if kv_sink is not None:
+        kv_sink.append((k, v))   # prefill capture for KV-cache decoding
     o = dispatch_attention(q, k, v, causal=causal, seq_axis=seq_axis,
                            attn_impl=attn_impl, kv_mask=kv_mask,
                            manual_axes=manual_axes)
@@ -128,12 +131,13 @@ class TransformerBlock:
             "mlp_out": L.Dense(self.d_ff, d, param_dtype=pd).init(ks[3]),
         }
 
-    def _attn(self, params, x, rng, train, kv_mask=None, manual_axes=()):
+    def _attn(self, params, x, rng, train, kv_mask=None, manual_axes=(),
+              kv_sink=None):
         return attention_sublayer(
             params, x, num_heads=self.num_heads, causal=self.causal,
             seq_axis=self.seq_axis, attn_impl=self.attn_impl,
             dropout_rate=self.dropout_rate, rng=rng, train=train,
-            kv_mask=kv_mask, manual_axes=manual_axes)
+            kv_mask=kv_mask, manual_axes=manual_axes, kv_sink=kv_sink)
 
     def _mlp(self, params, x, rng, train):
         h = L.Dense(self.d_model, self.d_ff).apply(params["mlp_in"], x)
@@ -142,7 +146,7 @@ class TransformerBlock:
         return L.dropout(h, self.dropout_rate, rng, train)
 
     def apply(self, params, x, *, rng=None, train: bool = False,
-              kv_mask=None, manual_axes=()):
+              kv_mask=None, manual_axes=(), kv_sink=None):
         r1 = r2 = None
         if train and rng is not None:
             r1, r2 = jax.random.split(rng)
@@ -150,14 +154,39 @@ class TransformerBlock:
         ln2 = L.LayerNorm(self.d_model)
         if self.pre_ln:
             x = x + self._attn(params, ln1.apply(params["ln1"], x), r1,
-                               train, kv_mask, manual_axes)
+                               train, kv_mask, manual_axes, kv_sink)
             x = x + self._mlp(params, ln2.apply(params["ln2"], x), r2, train)
         else:  # post-LN (BERT)
             x = ln1.apply(params["ln1"],
                           x + self._attn(params, x, r1, train, kv_mask,
-                                         manual_axes))
+                                         manual_axes, kv_sink))
             x = ln2.apply(params["ln2"], x + self._mlp(params, x, r2, train))
         return x
+
+    def decode_step(self, params, x, cache, pos):
+        """One KV-cached decode tick: ``x [B, 1, d]`` at position ``pos``.
+
+        Writes this step's K/V into ``cache`` (``{"k","v"}: [B, H, T_max,
+        hd]``) and attends over slots ``0..pos``. Pre-LN causal blocks
+        only — post-LN blocks are bidirectional (BERT) and have no
+        autoregressive decode.
+        """
+        assert self.causal and self.pre_ln, "decode needs a causal pre-LN block"
+        d = self.d_model
+        h = L.LayerNorm(d).apply(params["ln1"], x)
+        qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = A.split_heads(q, self.num_heads)
+        k = A.split_heads(k, self.num_heads)
+        v = A.split_heads(v, self.num_heads)
+        cache = {"k": lax.dynamic_update_slice_in_dim(
+                     cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
+                 "v": lax.dynamic_update_slice_in_dim(
+                     cache["v"], v.astype(cache["v"].dtype), pos, axis=2)}
+        o = A.cached_attention(q, cache["k"], cache["v"], pos)
+        x = x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o))
+        h = L.LayerNorm(d).apply(params["ln2"], x)
+        return x + self._mlp(params, h, None, False), cache
 
 
 # Megatron-style tensor-parallel layout for the block param names above.

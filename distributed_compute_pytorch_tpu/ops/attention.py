@@ -109,6 +109,37 @@ def attention(q, k, v, *, causal: bool = False, scale: float | None = None,
                                  mask=mask)
 
 
+def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None):
+    """Single-position decode attention over a preallocated K/V cache.
+
+    Args:
+      q: this step's query, ``[B, H, 1, hd]``.
+      k_cache, v_cache: ``[B, Hk, T_max, hd]`` caches already holding
+        positions ``0..pos`` (``pos`` included). ``Hk`` may be smaller than
+        ``H`` (GQA) — heads are repeated here, on the read path, so the
+        cache itself stays at kv-head width (the whole point of GQA:
+        cache memory and bandwidth scale with ``Hk``).
+      pos: scalar position of ``q``; cache slots beyond it are masked.
+
+    GQA reads the NARROW cache directly: the query's group dim folds into
+    its (length-1) sequence dim, so no ``[B, H, T_max, hd]`` repeat is
+    ever materialised — per-tick HBM traffic stays proportional to
+    ``Hk``, which is the point of grouped-query attention.
+
+    Returns ``[B, H, 1, hd]``.
+    """
+    B, H, q_len, hd = q.shape
+    hk = k_cache.shape[1]
+    grouped = H != hk
+    if grouped:
+        assert q_len == 1, "GQA cache read expects single-position queries"
+        q = q.reshape(B, hk, (H // hk) * q_len, hd)
+    valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, None, None, :]
+    out = dot_product_attention(q, k_cache, v_cache, mask=valid,
+                                scale=scale)
+    return out.reshape(B, H, q_len, hd) if grouped else out
+
+
 def split_heads(x, num_heads: int):
     """``[b, t, d]`` -> ``[b, h, t, d/h]``."""
     b, t, d = x.shape
