@@ -119,18 +119,17 @@ def _bench_convnet(jax, jnp, np, mesh, n_chips):
     return batch / dt / n_chips
 
 
-def _bench_gpt2(jax, jnp, np, mesh, n_chips, peak_flops):
+def _bench_causal_lm(jax, jnp, np, mesh, n_chips, peak_flops, model):
+    """Shared harness for the decoder-LM train rungs (GPT-2, Llama):
+    bf16 train step at T=1024, 16 sequences/chip (the measured single-chip
+    MFU sweet spot on v5e: B=8 0.46, B=16 0.49, B=24 0.48, B=32
+    OOM-pressure 0.44), MFU via the 6N + 12*L*T*d analytic convention."""
     from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
-    from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
     from distributed_compute_pytorch_tpu.train.optim import build_optimizer
     from distributed_compute_pytorch_tpu.train.step import make_step_fns
 
-    # batch scales with the slice so the (B, T) array shards evenly over
-    # any data-axis size; 16/chip is the measured single-chip MFU sweet spot
-    # (B=8 0.46, B=16 0.49, B=24 0.48, B=32 OOM-pressure 0.44 on v5e)
+    cfg = model.config
     B, T = 16 * n_chips, 1024
-    cfg = GPT2Config(dropout_rate=0.0)   # GPT-2-small: 12L/12H/768d, 50257v
-    model = GPT2(cfg)
     tx = build_optimizer("adamw", lr=3e-4, gamma=1.0, steps_per_epoch=100,
                          warmup_steps=10, total_steps=1000)
     init_fn, train_step, _ = make_step_fns(model, tx, mesh,
@@ -152,8 +151,16 @@ def _bench_gpt2(jax, jnp, np, mesh, n_chips, peak_flops):
         "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_bf16_flops_assumed": peak_flops,
-        "loss_finite": finite,
+        "n_params": int(n_params), "loss_finite": finite,
     }
+
+
+def _bench_gpt2(jax, jnp, np, mesh, n_chips, peak_flops):
+    from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+
+    # GPT-2-small: 12L/12H/768d, 50257v
+    return _bench_causal_lm(jax, jnp, np, mesh, n_chips, peak_flops,
+                            GPT2(GPT2Config(dropout_rate=0.0)))
 
 
 def _compile_step(train_step, *args):
@@ -199,6 +206,16 @@ def _time_steps(np, train_step, state, x, y, iters=20, warmup=4):
 
     dt = _two_length_dt(time_n, iters, repeats=2)
     return dt, bool(np.isfinite(np.asarray(st["m"]["loss"])))
+
+
+def _bench_llama(jax, jnp, np, mesh, n_chips, peak_flops):
+    """Llama-family rung: default config (12L/768d, GQA 12:4, SwiGLU,
+    RoPE, 32k vocab — ~125M params, GPT-2-small class)."""
+    from distributed_compute_pytorch_tpu.models.llama import (
+        LlamaConfig, LlamaLM)
+
+    return _bench_causal_lm(jax, jnp, np, mesh, n_chips, peak_flops,
+                            LlamaLM(LlamaConfig()))
 
 
 def _bench_resnet18(jax, jnp, np, mesh, n_chips, peak_flops):
@@ -456,7 +473,12 @@ def _bench_decode(jax, jnp, np, mesh, n_chips):
             best = min(best, time.perf_counter() - t0)
         return best
 
-    per_tok = (timed(256) - timed(128)) / 128
+    t1, t2 = timed(128), timed(256)
+    d = t2 - t1
+    # same jitter guard as _two_length_dt: if the difference isn't
+    # comfortably positive, fall back to the overhead-inflated (slower-
+    # than-true) full wall time rather than publishing a negative rate
+    per_tok = d / 128 if d > 0.02 * t2 else t2 / 256
     return {
         "batch": B, "prompt_len": T0, "new_tokens": 128,
         "per_tick_ms": round(per_tok * 1000, 3),
@@ -566,6 +588,7 @@ def main():
                     return {"error": f"{type(e).__name__}: {e}"[:300]}
 
     gpt2 = _stage(_bench_gpt2, jax, jnp, np, mesh, n_chips, peak)
+    llama = _stage(_bench_llama, jax, jnp, np, mesh, n_chips, peak)
     resnet = _stage(_bench_resnet18, jax, jnp, np, mesh, n_chips, peak)
     resnet50 = _stage(_bench_resnet50, jax, jnp, np, mesh, n_chips, peak)
     bert = _stage(_bench_bert, jax, jnp, np, mesh, n_chips, peak)
@@ -588,6 +611,7 @@ def main():
             "device_kind": device_kind,
             "n_chips": n_chips,
             "gpt2_small_bf16_t1024": gpt2,
+            "llama_125m_gqa_bf16_t1024": llama,
             "resnet18_cifar32_bf16": resnet,
             "resnet50_imagenet224_bf16": resnet50,
             "bert_base_mlm_bf16_t512": bert,

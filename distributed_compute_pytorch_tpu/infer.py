@@ -103,14 +103,15 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
             logits = model.readout(params, x)[:, -1]
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits, temperature, sub)
-            return (nxt, new_caches, rng), tok
+            return (nxt, new_caches, rng), nxt
 
-        # tick i consumes the token at position T0+i and decides T0+i+1;
-        # the scan's stacked outputs are exactly the max_new_tokens new
-        # tokens (the final tick's decision would be token T0+N — unused)
+        # tick i consumes the token at position T0+i and emits T0+i+1;
+        # `first` (position T0) came from prefill, so N-1 ticks complete
+        # the N new tokens with no wasted final iteration
         _, toks = lax.scan(tick, (first, caches, rng),
-                           jnp.arange(max_new_tokens))
-        return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
+                           jnp.arange(max_new_tokens - 1))
+        return jnp.concatenate(
+            [prompt, first[:, None], toks.transpose(1, 0)], axis=1)
 
     def generate(params, prompt, rng=None):
         rng = jax.random.key(0) if rng is None else rng
@@ -120,12 +121,16 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                 f"t_max={tm} can't hold prompt {prompt.shape[1]} + "
                 f"{max_new_tokens} new tokens")
         model_cap = getattr(model.config, "max_seq_len", None)
-        if model_cap is not None and tm > model_cap:
+        final = prompt.shape[1] + max_new_tokens
+        if model_cap is not None and final > model_cap:
             # past this, learned position tables would be indexed out of
             # range — and JAX gather CLAMPS instead of raising, so the
-            # output would be silently wrong
+            # output would be silently wrong. (The cache may legitimately
+            # be LARGER than the model capacity; only positions actually
+            # reached matter.)
             raise ValueError(
-                f"t_max={tm} exceeds the model's max_seq_len={model_cap}")
+                f"prompt ({prompt.shape[1]}) + {max_new_tokens} new tokens "
+                f"exceeds the model's max_seq_len={model_cap}")
         return _generate(params, prompt, rng, tm)
 
     generate._jitted = _generate   # exposed for cache/retrace inspection
