@@ -464,21 +464,15 @@ def _bench_decode(jax, jnp, np, mesh, n_chips):
         int(np.asarray(gen(params, prompt))[0, -1])   # compile + warm
         runs[n] = gen
 
-    def timed(n):
-        best = 1e9
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = runs[n](params, prompt)
-            np.asarray(out[0, -1])
-            best = min(best, time.perf_counter() - t0)
-        return best
+    def time_n(n):
+        t0 = time.perf_counter()
+        out = runs[n](params, prompt)
+        np.asarray(out[0, -1])
+        return time.perf_counter() - t0
 
-    t1, t2 = timed(128), timed(256)
-    d = t2 - t1
-    # same jitter guard as _two_length_dt: if the difference isn't
-    # comfortably positive, fall back to the overhead-inflated (slower-
-    # than-true) full wall time rather than publishing a negative rate
-    per_tok = d / 128 if d > 0.02 * t2 else t2 / 256
+    # n = generated-token count: wall(256) - wall(128) over the extra 128
+    # ticks, with _two_length_dt's shared jitter guard
+    per_tok = _two_length_dt(time_n, 128)
     return {
         "batch": B, "prompt_len": T0, "new_tokens": 128,
         "per_tick_ms": round(per_tok * 1000, 3),

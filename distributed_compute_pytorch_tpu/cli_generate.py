@@ -1,0 +1,87 @@
+"""``dcp-generate`` — sample tokens from a trained causal-LM checkpoint.
+
+The inference-side companion of ``dcp-train`` (the reference repo trains
+only; ``/root/reference/main.py`` has no generation path). The framework
+carries no tokenizer (the reference has none either), so prompts and
+outputs are token-id sequences — the contract every tokenizer-owning
+caller can script against:
+
+    dcp-generate --ckpt_path ck.npz --model gpt2 --model_preset tiny \\
+        --prompt 12,7,90 --max_new_tokens 16 --temperature 0.8
+
+Prints one JSON line: {"prompt": [...], "tokens": [...], "new": [...]}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parse_prompt(s: str) -> list[int]:
+    try:
+        ids = [int(t) for t in s.replace(",", " ").split()]
+    except ValueError:
+        raise SystemExit(f"--prompt must be token ids, got {s!r}")
+    if not ids:
+        raise SystemExit("--prompt is empty")
+    return ids
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ckpt_path", required=True,
+                   help="checkpoint written by dcp-train (v1 file or "
+                        "sharded v2 directory)")
+    p.add_argument("--model", default="gpt2", choices=("gpt2", "llama"),
+                   help="causal families only (BERT is bidirectional)")
+    p.add_argument("--model_preset", default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--max_seq_len", type=int, default=None)
+    p.add_argument("--prompt", required=True,
+                   help="comma/space-separated token ids")
+    p.add_argument("--max_new_tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
+    args = p.parse_args(argv)
+
+    if args.force_cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_compute_pytorch_tpu.infer import generate
+    from distributed_compute_pytorch_tpu.models.registry import build_model
+    from distributed_compute_pytorch_tpu.train.checkpoint import (
+        restore_params)
+
+    kw = {k: v for k, v in (("preset", args.model_preset),
+                            ("vocab_size", args.vocab_size),
+                            ("max_seq_len", args.max_seq_len))
+          if v is not None}
+    model = build_model(args.model, **kw)
+    template, _ = model.init(jax.random.key(0))
+    params = restore_params(args.ckpt_path, template)
+
+    ids = _parse_prompt(args.prompt)
+    vocab = model.config.vocab_size
+    bad = [t for t in ids if not 0 <= t < vocab]
+    if bad:
+        # the embedding gather would CLAMP out-of-range ids silently
+        raise SystemExit(f"prompt ids {bad} outside vocab [0, {vocab})")
+    prompt = jnp.asarray(ids, jnp.int32)[None, :]
+    out = generate(model, params, prompt, args.max_new_tokens,
+                   temperature=args.temperature,
+                   rng=jax.random.key(args.seed))
+    toks = [int(t) for t in out[0]]
+    print(json.dumps({"prompt": ids, "tokens": toks,
+                      "new": toks[len(ids):]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,6 +102,9 @@ class Config:
     # pipeline-stage tick — the 1F1B memory profile; needs a pipe>1 mesh,
     # see parallel/pipeline.py)
     remat_mode: str = "block"
+    # device-side train-time image augmentation (ops/augment.py), traced
+    # into the jitted step: none | flip | flip-crop
+    augment: str = "none"
     compile_cache_dir: str | None = field(
         default_factory=lambda: _env("DCP_COMPILE_CACHE"))
                                      # persistent XLA compile cache (skip
@@ -203,6 +206,10 @@ class Config:
         p.add_argument("--remat", action="store_true",
                        help="rematerialise transformer blocks on backward "
                             "(bigger batches when HBM binds)")
+        p.add_argument("--augment", type=str, default=cls.augment,
+                       choices=("none", "flip", "flip-crop"),
+                       help="device-side train-time image augmentation "
+                            "(traced into the jitted step; image models)")
         p.add_argument("--compile_cache_dir", type=str, default=None,
                        help="persistent XLA compile cache directory "
                             "(env DCP_COMPILE_CACHE)")

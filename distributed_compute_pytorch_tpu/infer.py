@@ -82,10 +82,14 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
     ``t_max`` caps the cache length (default ``T0 + max_new_tokens`` at
     trace time); one compilation per (model, prompt-shape, max_new).
     """
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     block = model._block()
 
     @partial(jax.jit, static_argnames=("_tmax",))
     def _generate(params, prompt, rng, _tmax):
+        if max_new_tokens == 0:        # static: prefill-only no-op
+            return prompt
         B, T0 = prompt.shape
         last_logits, caches = prefill(model, params, prompt, _tmax)
         rng, sub = jax.random.split(rng)   # use-once keys: fresh half here

@@ -1,0 +1,61 @@
+"""Device-side image augmentation — runs INSIDE the jitted train step.
+
+The reference applies its (only) input transforms host-side per batch via
+torchvision (``/root/reference/main.py:107-116``). The TPU-first design
+inverts that: augmentation is traced into the train step, so it costs no
+host CPU, no extra host->device transfer, and XLA fuses it with the input
+cast. Randomness comes from the step rng (``train/step.py``), which is
+replicated — every device computes the same per-example decisions, so a
+batch-sharded input stays consistent without communication, and layout
+equivalence (DP == FSDP == ...) holds exactly.
+
+Menu (the standard CIFAR/ImageNet training recipe):
+- ``flip``: per-example random horizontal mirror (p=0.5).
+- ``flip-crop``: flip + pad-by-``pad``-and-random-crop back to size (the
+  shift augmentation; per-example offsets via a vmapped dynamic_slice —
+  static output shapes, compiles once).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_flip(x, rng):
+    """Per-example horizontal mirror with p=0.5. ``x [B, H, W, C]``."""
+    flips = jax.random.bernoulli(rng, 0.5, (x.shape[0],))
+    return jnp.where(flips[:, None, None, None], x[:, :, ::-1, :], x)
+
+
+def random_crop(x, rng, pad: int = 4):
+    """Pad H/W by ``pad`` (zeros) and crop back at a per-example offset.
+
+    The uniform offset in ``[0, 2*pad]`` makes the identity crop exactly
+    as likely as any shift; output shape equals input shape, so one
+    compilation serves the whole run.
+    """
+    B, H, W, C = x.shape
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    ky, kx = jax.random.split(rng)
+    oy = jax.random.randint(ky, (B,), 0, 2 * pad + 1)
+    ox = jax.random.randint(kx, (B,), 0, 2 * pad + 1)
+    crop1 = lambda img, y0, x0: lax.dynamic_slice(img, (y0, x0, 0),
+                                                  (H, W, C))
+    return jax.vmap(crop1)(xp, oy, ox)
+
+
+def build_augment(spec: str, pad: int = 4):
+    """``spec`` -> ``augment(x, rng) -> x`` callable, or None for 'none'."""
+    if spec in (None, "", "none"):
+        return None
+    if spec == "flip":
+        return random_flip
+    if spec == "flip-crop":
+        def fn(x, rng):
+            r1, r2 = jax.random.split(rng)
+            return random_crop(random_flip(x, r1), r2, pad)
+        return fn
+    raise ValueError(f"unknown augment spec {spec!r}; "
+                     f"expected none | flip | flip-crop")

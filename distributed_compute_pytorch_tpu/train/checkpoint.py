@@ -304,14 +304,15 @@ def _assemble(path: str, pieces, span_lo, out):
             z.close()
 
 
-def _restore_sharded(path: str, template, shardings=None):
+def _restore_sharded(path: str, template, shardings=None, *,
+                     _prefix: str = ""):
     entries = _sharded_entry_map(path)
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     flat_shardings = (jax.tree_util.tree_leaves(shardings)
                       if shardings is not None else [None] * len(paths))
     leaves = []
     for (path_keys, leaf), shard in zip(paths, flat_shardings):
-        key = _SEP.join(
+        key = _prefix + _SEP.join(
             str(getattr(k, "key", getattr(k, "idx", k))) for k in path_keys)
         if key not in entries:
             raise KeyError(f"checkpoint missing leaf {key!r}")
@@ -414,7 +415,7 @@ class AsyncCheckpointer:
         self.close()
 
 
-def restore(path: str, template, shardings=None):
+def restore(path: str, template, shardings=None, *, _prefix: str = ""):
     """Read a checkpoint back into ``template``'s pytree structure.
 
     ``template`` provides structure/dtypes (e.g. a freshly-initialised
@@ -423,27 +424,59 @@ def restore(path: str, template, shardings=None):
     materialising the full model on one device per leaf batch. Both formats
     restore under ANY mesh (elastic resize): the v1 file holds unsharded
     leaves; the v2 directory is reassembled span-by-span.
+
+    ``_prefix`` offsets every template key into the stored tree (see
+    :func:`restore_params`).
     """
     if os.path.isdir(path):
-        return _restore_sharded(path, template, shardings)
-    with np.load(path, allow_pickle=False) as z:
-        flat = {k: z[k] for k in z.files if k != "__manifest__"}
+        return _restore_sharded(path, template, shardings, _prefix=_prefix)
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     flat_shardings = (jax.tree_util.tree_leaves(shardings)
                       if shardings is not None else [None] * len(paths))
+    # NpzFile reads lazily per key: only the template's leaves are ever
+    # decompressed, so a params-only restore (restore_params) never pays
+    # for the optimizer-moment trees also stored in the file
+    with np.load(path, allow_pickle=False) as z:
+        available = set(z.files)
+        _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
+                           _prefix)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
+                       _prefix):
     for (path_keys, leaf), shard in zip(paths, flat_shardings):
-        key = _SEP.join(
+        key = _prefix + _SEP.join(
             str(getattr(k, "key", getattr(k, "idx", k))) for k in path_keys)
-        if key not in flat:
+        if key not in available:
             raise KeyError(f"checkpoint missing leaf {key!r}")
-        arr = flat[key]
+        arr = z[key]
         if isinstance(leaf, jax.Array) and jnp.issubdtype(
                 leaf.dtype, jax.dtypes.prng_key):
             new = jax.random.wrap_key_data(jnp.asarray(arr))
         else:
+            want = np.shape(leaf)
+            if want and arr.shape != want:
+                # same contract as the v2 path: a silently wrong-shaped
+                # leaf (model config drifted since the save) must not load
+                raise ValueError(
+                    f"checkpoint leaf {key!r} was saved with shape "
+                    f"{arr.shape} but the template wants {want} — model "
+                    f"configuration changed since the save")
             new = jnp.asarray(arr, dtype=getattr(leaf, "dtype", None))
         if shard is not None:
             new = jax.device_put(new, shard)
         leaves.append(new)
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def restore_params(path: str, params_template, shardings=None):
+    """Restore ONLY the model parameters from a (v1 or v2) checkpoint.
+
+    Inference loaders (``dcp-generate``) have no optimizer, so they cannot
+    rebuild the full TrainState template that :func:`restore` wants; this
+    reads just the ``params`` subtree by offsetting every key with the
+    state's ``.params`` prefix.
+    """
+    return restore(path, params_template, shardings,
+                   _prefix=".params" + _SEP)

@@ -61,13 +61,16 @@ class TrainState:
 
 
 def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
-                  strategy=None, donate: bool = True, compute_dtype=None):
+                  strategy=None, donate: bool = True, compute_dtype=None,
+                  augment=None):
     """Build ``(init_fn, train_step, eval_step)`` for ``model`` on ``mesh``.
 
     ``strategy`` decides parameter layout (default pure DP = replicated,
     reference parity). ``compute_dtype`` (e.g. ``jnp.bfloat16``) casts
     floating-point inputs before the forward pass — the TPU fast path; params
-    stay in their own dtype and are cast inside the layers. The returned
+    stay in their own dtype and are cast inside the layers. ``augment`` is an
+    optional ``(x, rng) -> x`` transform (``ops/augment.py``) traced into the
+    TRAIN step only — device-side augmentation, eval untouched. The returned
     functions are jit-compiled; train_step donates the state buffers.
     """
     strategy = strategy or DataParallel()
@@ -138,6 +141,10 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         """One optimization step == reference ``train`` body (``main.py:57-63``)."""
         x = _cast(x)
         step_rng = jax.random.fold_in(state.rng, state.step)
+        if augment is not None:
+            # dedicated key: the model's rng stream is unchanged whether or
+            # not augmentation is on
+            x = augment(x, jax.random.fold_in(step_rng, 0x41554747))
 
         if hasattr(model, "train_loss"):
             # models owning their objective end-to-end (e.g. BERT's MLM

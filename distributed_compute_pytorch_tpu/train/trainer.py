@@ -98,9 +98,20 @@ class Trainer:
             total_steps=self.train_feed.steps_per_epoch * config.epochs)
         compute_dtype = (None if config.compute_dtype in (None, "float32")
                          else jnp.dtype(config.compute_dtype))
+        augment = None
+        if config.augment not in (None, "none"):
+            from distributed_compute_pytorch_tpu.ops.augment import (
+                build_augment)
+            if self.train_data.inputs.ndim == 4:   # [B, H, W, C] images
+                augment = build_augment(config.augment)
+            else:
+                log0(f"WARNING: --augment {config.augment} needs image "
+                     f"(rank-4) inputs; {config.dataset!r} provides rank "
+                     f"{self.train_data.inputs.ndim} — ignored")
         self.init_fn, self.train_step, self.eval_step = make_step_fns(
             self.model, self.tx, self.mesh, self.strategy,
-            donate=config.donate, compute_dtype=compute_dtype)
+            donate=config.donate, compute_dtype=compute_dtype,
+            augment=augment)
 
         self.state = self.init_fn(jax.random.key(config.seed))
         self.start_epoch = 0

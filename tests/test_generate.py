@@ -70,6 +70,16 @@ def test_temperature_sampling_deterministic_per_key():
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
+def test_zero_new_tokens_is_identity():
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, 256)
+    out = generate(model, params, prompt, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        make_generate_fn(model, -1)
+
+
 def test_t_max_capacity_validated():
     model = GPT2(GPT2Config.tiny())
     params, _ = model.init(jax.random.key(0))
@@ -87,6 +97,71 @@ def test_model_capacity_validated():
     prompt = jnp.zeros((1, 60), jnp.int32)
     with pytest.raises(ValueError, match="max_seq_len"):
         generate(model, params, prompt, 8)
+
+
+def test_restore_params_from_full_checkpoint(tmp_path, devices8):
+    """restore_params reads just the params subtree of a full TrainState
+    checkpoint — no optimizer needed on the inference side — from both the
+    v1 file and the sharded v2 directory formats."""
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+    from distributed_compute_pytorch_tpu.train import checkpoint
+    from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+    from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+    mesh = make_mesh("data=8", devices=devices8)
+    model = GPT2(GPT2Config.tiny())
+    tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+    init_fn, _, _ = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(3))
+
+    v1 = str(tmp_path / "ck.npz")
+    checkpoint.save(v1, state, epoch=0)
+    v2 = str(tmp_path / "ckdir")
+    checkpoint.save_sharded(v2, state, epoch=0)
+
+    template, _ = model.init(jax.random.key(0))
+    for path in (v1, v2):
+        params = checkpoint.restore_params(path, template)
+        for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(
+                            state.params)),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cli_generate_end_to_end(tmp_path, capsys, devices8):
+    """dcp-train writes a checkpoint; dcp-generate samples from it."""
+    import json
+
+    from distributed_compute_pytorch_tpu.cli_generate import main as gen_main
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    ck = str(tmp_path / "ck.npz")
+    data = synthetic_lm(64, seq_len=16, vocab=256, seed=9)
+    cfg = Config(batch_size=32, lr=1e-3, epochs=1, mesh="data=8",
+                 model="gpt2", model_preset="tiny", dataset="synthetic-lm",
+                 optimizer="adamw", ckpt_path=ck)
+    Trainer(cfg, train_data=data, eval_data=data).fit()
+
+    # model config must match the training run (the trainer sized
+    # max_seq_len to the dataset); a mismatch raises in restore_params
+    rc = gen_main(["--ckpt_path", ck, "--model", "gpt2",
+                   "--model_preset", "tiny", "--max_seq_len", "16",
+                   "--prompt", "5, 9, 12", "--max_new_tokens", "6"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["prompt"] == [5, 9, 12]
+    assert len(out["new"]) == 6
+    assert out["tokens"][:3] == [5, 9, 12]
+    assert all(0 <= t < 256 for t in out["new"])
+
+    # a config that doesn't match the save must raise, not silently load
+    # wrong-shaped weights (v1 now validates shapes like v2 always did)
+    with pytest.raises(ValueError, match="configuration changed"):
+        gen_main(["--ckpt_path", ck, "--model", "gpt2",
+                  "--model_preset", "tiny", "--prompt", "5",
+                  "--max_new_tokens", "2"])
 
 
 def test_generate_is_one_compiled_program():
