@@ -42,17 +42,28 @@ from distributed_compute_pytorch_tpu.models import layers as L
 
 
 def _constrain(x, spec: P):
-    """Pin ``x``'s sharding when a mesh context is active (no-op off-mesh)."""
+    """Pin ``x``'s sharding when a mesh context is active (no-op off-mesh).
+
+    Inside a shard_map manual region (the pipeline runs MoE blocks manual
+    over ``pipe``/``seq``), the constraint must be built on the ABSTRACT
+    mesh — it knows which axes are Manual — and may only name the still-
+    Auto axes; a constraint on the concrete mesh there is an error."""
     mesh = current_mesh()
     if mesh is None:
         return x
+    am = jax.sharding.get_abstract_mesh()
+    manual = (set() if am is None or am.empty else
+              {n for n, t in zip(am.axis_names, am.axis_types)
+               if t == jax.sharding.AxisType.Manual})
     cleaned = tuple(
-        a if (a in mesh.axis_names and mesh.shape[a] > 1) else None
+        a if (a in mesh.axis_names and mesh.shape[a] > 1
+              and a not in manual) else None
         for a in spec)
     if all(a is None for a in cleaned):
         return x
+    target = mesh if not manual else am
     return jax.lax.with_sharding_constraint(
-        x, jax.sharding.NamedSharding(mesh, P(*cleaned)))
+        x, jax.sharding.NamedSharding(target, P(*cleaned)))
 
 
 @dataclass(frozen=True)
@@ -190,6 +201,7 @@ class MoETransformerConfig:
     z_weight: float = 1e-3
     dropout_rate: float = 0.0
     remat: bool = False            # rematerialise blocks on backward
+    pipeline_microbatches: int | None = None   # GPipe M (None = pipe size)
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -205,8 +217,10 @@ class MoETransformerLM:
     Same skeleton as GPT-2 (pre-LN, fused-QKV causal attention, tied
     readout) with the dense MLP swapped for :class:`MoELayer`; blocks are
     stacked and scanned with the aux losses accumulated through the scan
-    carry. ``pipe`` is not supported for MoE yet (aux plumbing); compose
-    with data/fsdp/tensor/expert axes.
+    carry — or pipelined over a ``pipe`` axis, where the GPipe schedule
+    carries the aux sums (``pipeline_blocks(aux_init=...)``) and averages
+    them over microbatches. Composes with data/fsdp/tensor/expert (and,
+    through the manual-region attention dispatch, ``seq``).
     """
 
     config: MoETransformerConfig = MoETransformerConfig()
@@ -230,7 +244,7 @@ class MoETransformerLM:
             "moe": self._moe().init(ks[2]),
         }
 
-    def _block_apply(self, p, x, rng, train):
+    def _block_apply(self, p, x, rng, train, manual_axes=()):
         from distributed_compute_pytorch_tpu.models.transformer import (
             attention_sublayer)
         c = self.config
@@ -240,7 +254,7 @@ class MoETransformerLM:
         # seq>1 mesh — same dispatch as the dense blocks)
         a = attention_sublayer(p, h, num_heads=c.num_heads, causal=True,
                                dropout_rate=c.dropout_rate, rng=rng,
-                               train=train)
+                               train=train, manual_axes=manual_axes)
         x = x + a
         h = L.LayerNorm(d).apply(p["ln2"], x)
         y, aux = self._moe().apply(p["moe"], h)
@@ -271,23 +285,42 @@ class MoETransformerLM:
         x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"],
                                                          jnp.arange(T))
         L_n = c.num_layers
+        from distributed_compute_pytorch_tpu.core.mesh import current_mesh
         from distributed_compute_pytorch_tpu.parallel.pipeline import (
-            remat_wrap)
-        block_apply = (remat_wrap(self._block_apply) if c.remat
-                       else self._block_apply)
+            pipeline_blocks, remat_wrap)
 
-        def body(carry, scanned):
-            h, lb, z, dr = carry
-            i, p = scanned
-            r = (jax.random.fold_in(rng, i)
-                 if (rng is not None and train) else None)
-            h, aux = block_apply(p, h, r, train)
-            return (h, lb + aux["lb_loss"], z + aux["z_loss"],
-                    dr + aux["dropped_fraction"]), None
+        mesh = current_mesh()
+        if (mesh is not None and "pipe" in mesh.axis_names
+                and mesh.shape["pipe"] > 1):
+            # GPipe path: the pipeline sums aux over layers and averages
+            # it over microbatches (exactly the scanned full-batch value
+            # for these mean-based metrics when moe_group_size divides the
+            # microbatch's tokens)
+            def block_apply(p, h, rng=None, train=False, manual_axes=()):
+                return self._block_apply(p, h, rng, train, manual_axes)
+            zeros = {"lb_loss": 0.0, "z_loss": 0.0, "dropped_fraction": 0.0}
+            x, aux = pipeline_blocks(
+                block_apply, params["blocks"], x, mesh,
+                num_microbatches=c.pipeline_microbatches, rng=rng,
+                train=train, remat=c.remat, aux_init=zeros)
+            lb, z, dr = (aux["lb_loss"], aux["z_loss"],
+                         aux["dropped_fraction"])
+        else:
+            block_apply = (remat_wrap(self._block_apply) if c.remat
+                           else self._block_apply)
 
-        (x, lb, z, dr), _ = jax.lax.scan(
-            body, (x, jnp.float32(0), jnp.float32(0), jnp.float32(0)),
-            (jnp.arange(L_n), params["blocks"]))
+            def body(carry, scanned):
+                h, lb, z, dr = carry
+                i, p = scanned
+                r = (jax.random.fold_in(rng, i)
+                     if (rng is not None and train) else None)
+                h, aux = block_apply(p, h, r, train)
+                return (h, lb + aux["lb_loss"], z + aux["z_loss"],
+                        dr + aux["dropped_fraction"]), None
+
+            (x, lb, z, dr), _ = jax.lax.scan(
+                body, (x, jnp.float32(0), jnp.float32(0), jnp.float32(0)),
+                (jnp.arange(L_n), params["blocks"]))
         x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
         logits = wte.attend(params["wte"], x)
         self_aux = {"lb_loss": lb / L_n, "z_loss": z / L_n,

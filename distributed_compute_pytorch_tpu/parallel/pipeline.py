@@ -143,7 +143,7 @@ def _block_extra_kwargs(block_apply) -> frozenset:
 def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
                     axis: str = "pipe", *, num_microbatches: int | None = None,
                     rng=None, train: bool = False,
-                    remat: bool | str = False, kv_mask=None):
+                    remat: bool | str = False, kv_mask=None, aux_init=None):
     """Run stacked layers as a GPipe pipeline over ``mesh``'s ``axis``.
 
     Args:
@@ -163,6 +163,13 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         inputs only, the 1F1B memory profile; see module docstring).
       kv_mask: optional ``[B, T]`` key-validity mask, microbatched alongside
         ``x``; each stage reads the slice of the microbatch it holds.
+      aux_init: optional pytree of float32 SCALAR zeros declaring that
+        ``block_apply`` returns ``(h, aux)`` with this structure (MoE's
+        load-balance/z losses). Per-layer aux is summed over layers and
+        MEAN-ed over microbatches — for mean-based metrics this equals the
+        unpipelined full-batch value, since microbatches are equal-sized.
+        Warmup/drain ticks (stage ``s`` active only for ``s <= t < s+M``)
+        are excluded. The return becomes ``(y, aux_total)``.
 
     When the mesh also carries a ``seq`` axis > 1, the region goes manual
     over BOTH ``pipe`` and ``seq``: activations are seq-split, the mask
@@ -183,8 +190,14 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             "kv_mask was given but block_apply's signature does not accept "
             "a `kv_mask` kwarg — pass the block's own apply (e.g. "
             "TransformerBlock.apply), not a signature-erasing wrapper.")
+    with_aux = aux_init is not None
     P_size = mesh.shape[axis]
     if P_size == 1:
+        if with_aux:
+            raise ValueError(
+                "aux_init needs a pipe>1 mesh — off-pipeline, scan the "
+                "blocks yourself and accumulate aux in the scan carry "
+                "(models/moe.py does)")
         # no pipe: stage remat degrades to block remat (the only stage is
         # the whole stack; per-block is the strictly better grain there)
         if kv_mask is not None:
@@ -227,7 +240,8 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         call_block = jax.checkpoint(call_block, prevent_cse=False)
 
     def stage_fn(params_local, h, mk, stage, mb_id):
-        def layer_body(h, scanned):
+        def layer_body(carry, scanned):
+            h, acc = carry
             i, p = scanned
             r = None
             if rng is not None and train:
@@ -236,9 +250,22 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
                 if seq_manual:
                     # independent dropout bits per seq chunk
                     r = jax.random.fold_in(r, lax.axis_index("seq"))
-            return call_block(p, h, r, mk), None
-        h, _ = lax.scan(layer_body, h, (jnp.arange(L_local), params_local))
-        return h
+            out = call_block(p, h, r, mk)
+            if with_aux:
+                h, aux = out
+                acc = jax.tree.map(jnp.add, acc, aux)
+            else:
+                h = out
+            return (h, acc), None
+        # aux carry must be typed varying like h (it mixes with per-layer
+        # aux derived from varying activations)
+        acc0 = jax.tree.map(
+            lambda a: lax.pcast(jnp.zeros((), jnp.float32), manual,
+                                to="varying"),
+            aux_init) if with_aux else ()
+        (h, acc), _ = lax.scan(layer_body, (h, acc0),
+                               (jnp.arange(L_local), params_local))
+        return h, acc
 
     if remat == "stage":
         # 1F1B memory profile: the only residual autodiff keeps per tick is
@@ -252,8 +279,11 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     m_spec = P(None, None, "seq") if seq_manual else P()
     in_specs = (P(axis), x_spec) + ((m_spec,) if masked else ())
 
+    out_specs = ((x_spec, jax.tree.map(lambda _: P(), aux_init))
+                 if with_aux else x_spec)
+
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=in_specs, out_specs=x_spec,
+             in_specs=in_specs, out_specs=out_specs,
              axis_names=set(manual))
     def _pipe(params_local, x_mb, *maybe_mask):
         # params_local leaves: [L_local, ...]; x_mb: [M, mb, T(/seq), d]
@@ -267,32 +297,56 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         outputs = lax.pcast(jnp.zeros(x_mb.shape, x_mb.dtype), manual,
                             to="varying")
 
+        aux_acc = jax.tree.map(
+            lambda a: lax.pcast(jnp.zeros((), jnp.float32), manual,
+                                to="varying"),
+            aux_init) if with_aux else ()
+
         def tick(carry, t):
-            state, outputs = carry
+            state, outputs, aux_acc = carry
             # stage 0 injects microbatch t (mod M; ticks past M feed stale
             # data whose outputs never reach a valid output slot)
             inp = jnp.where(stage == 0, x_mb[t % M], state)
             mb_id = (t - stage) % M              # microbatch this stage holds
             mk = mask_mb[mb_id] if masked else None
-            y = stage_fn(params_local, inp, mk, stage, mb_id)
+            y, aux = stage_fn(params_local, inp, mk, stage, mb_id)
+            if with_aux:
+                # warmup/drain ticks compute garbage: count a stage's aux
+                # only while it holds a real microbatch
+                live = jnp.logical_and(t >= stage, t < stage + M)
+                live = live.astype(jnp.float32)
+                aux_acc = jax.tree.map(lambda a, s: a + live * s,
+                                       aux_acc, aux)
             # the last stage finished microbatch t-(P-1) this tick; earlier
             # (t < P-1) writes land on slots that valid later ticks rewrite
             out_idx = (t - (P_size - 1)) % M
             outputs = outputs.at[out_idx].set(
                 jnp.where(stage == P_size - 1, y, outputs[out_idx]))
             state = lax.ppermute(y, axis, perm)
-            return (state, outputs), None
+            return (state, outputs, aux_acc), None
 
-        (state, outputs), _ = lax.scan(tick, (state, outputs),
-                                       jnp.arange(M + P_size - 1))
+        (state, outputs, aux_acc), _ = lax.scan(
+            tick, (state, outputs, aux_acc), jnp.arange(M + P_size - 1))
         # only the last stage holds real outputs; mask + psum replicates
         # them across the pipe axis (single cross-stage collective)
         outputs = jnp.where(stage == P_size - 1, outputs, 0)
-        return lax.psum(outputs, axis)
+        outputs = lax.psum(outputs, axis)
+        if not with_aux:
+            return outputs
+        # per-stage acc = sum over its layers and M microbatches; psum over
+        # pipe joins the layer partition, /M averages microbatches; under
+        # seq-manual each shard saw its own chunk-mean — average those too
+        def _finish(a):
+            a = lax.psum(a, axis) / M
+            return lax.pmean(a, "seq") if seq_manual else a
+        return outputs, jax.tree.map(_finish, aux_acc)
 
     x_mb = x.reshape(M, mb, *x.shape[1:])
     args = (stacked_params, x_mb)
     if masked:
         args += (kv_mask.reshape(M, mb, *kv_mask.shape[1:]),)
+    if with_aux:
+        y_mb, aux = _pipe(*args)
+        return y_mb.reshape(x.shape), aux
     y_mb = _pipe(*args)
     return y_mb.reshape(x.shape)

@@ -224,17 +224,16 @@ class Trainer:
             if cfg.model_preset == "tiny" or cfg.dataset.startswith("synthetic"):
                 kw["vocab_size"] = max(self.train_data.num_classes, 4)
                 kw["max_seq_len"] = int(inputs.shape[1])
-        if cfg.model in ("bert", "gpt2", "llama") and cfg.microbatches:
+        if (cfg.model in ("bert", "gpt2", "llama", "moe")
+                and cfg.microbatches):
             kw["pipeline_microbatches"] = cfg.microbatches
         if cfg.remat:
             if cfg.model in ("bert", "gpt2", "moe", "llama"):
                 stage_ok = (cfg.remat_mode == "stage"
-                            and cfg.model != "moe"
                             and dict(self.mesh.shape).get("pipe", 1) > 1)
                 if cfg.remat_mode == "stage" and not stage_ok:
-                    log0("WARNING: --remat_mode stage needs a pipe>1 mesh "
-                         "and a bert/gpt2/llama model; falling back to per-block "
-                         "remat")
+                    log0("WARNING: --remat_mode stage needs a pipe>1 mesh; "
+                         "falling back to per-block remat")
                 kw["remat"] = "stage" if stage_ok else True
             else:
                 log0(f"WARNING: --remat is not supported by model "
