@@ -1,30 +1,38 @@
-"""Torch-checkpoint interop: import the reference's ``mnist.pt``.
+"""Torch-checkpoint interop: both directions, two model families.
 
 The reference persists ``torch.save(model.state_dict(), "mnist.pt")``
 (``/root/reference/main.py:133``), with keys ``module.``-prefixed iff the
 model was DDP-wrapped (SURVEY §A.6 schema drift). A user switching from the
-reference to this framework can carry those checkpoints over: this module
-converts the torch state_dict of the reference ConvNet into framework
-``(params, state)``, handling the layout differences that the TPU-native
-design introduces:
+reference to this framework can carry those checkpoints over — and back:
 
-- conv kernels: torch OIHW -> our HWIO,
-- linear kernels: torch ``[out, in]`` -> our ``[in, out]``,
-- ``fc1`` additionally permutes its input features: torch flattens NCHW
-  (channel-major ``c,h,w``) while we flatten NHWC (``h,w,c``), so the 9216
-  columns are reordered to keep the matmul identical,
-- BatchNorm1d: ``weight/bias`` -> ``scale/bias`` params; ``running_mean/
-  running_var`` -> framework model-state (``num_batches_tracked`` dropped —
-  the framework tracks schedule state elsewhere).
+- ConvNet: :func:`convnet_from_torch_state_dict` /
+  :func:`convnet_to_torch_state_dict` (the reference model, ``main.py:20-45``),
+- Llama: :func:`llama_from_hf_state_dict` / :func:`llama_to_hf_state_dict`
+  (HF ``transformers`` ``LlamaForCausalLM`` schema — load open pretrained
+  weights into the framework, or ship framework-trained weights to any
+  HF-compatible runtime).
 
-Equivalence (same log-probs as the torch model in eval mode) is pinned in
-``tests/test_torch_import.py``.
+Layout differences the TPU-native design introduces, handled here:
+
+- conv kernels: torch OIHW <-> our HWIO,
+- linear kernels: torch ``[out, in]`` <-> our ``[in, out]``,
+- ConvNet ``fc1`` additionally permutes its input features: torch flattens
+  NCHW (channel-major ``c,h,w``) while we flatten NHWC (``h,w,c``), so the
+  9216 columns are reordered to keep the matmul identical,
+- BatchNorm1d: ``weight/bias`` <-> ``scale/bias`` params; ``running_mean/
+  running_var`` <-> framework model-state,
+- Llama blocks are STACKED (leading ``[num_layers]`` dim) here vs
+  per-layer ``model.layers.{i}.*`` keys in HF.
+
+Equivalence (same outputs as the torch models in eval mode) is pinned in
+``tests/test_torch_import.py`` and ``tests/test_llama.py``.
 """
 
 from __future__ import annotations
 
 from typing import Any, Mapping
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -106,3 +114,131 @@ def load_reference_checkpoint(path: str, model: ConvNet | None = None
     import torch
     sd = torch.load(path, map_location="cpu", weights_only=True)
     return convnet_from_torch_state_dict(sd, model)
+
+
+def convnet_to_torch_state_dict(params: PyTree, state: PyTree,
+                                model: ConvNet | None = None
+                                ) -> dict[str, np.ndarray]:
+    """Framework ConvNet ``(params, state)`` -> reference torch schema.
+
+    Exact inverse of :func:`convnet_from_torch_state_dict` (round-trip is
+    bit-exact); values are numpy — wrap in ``torch.from_numpy`` to feed
+    ``Model.load_state_dict``.
+    """
+    model = model or ConvNet()
+
+    def conv(tree):
+        # HWIO -> OIHW
+        return (np.asarray(tree["kernel"], np.float32).transpose(3, 2, 0, 1),
+                np.asarray(tree["bias"], np.float32))
+
+    h, w = model.image_size
+    fh, fw = (h - 4) // 2, (w - 4) // 2
+    fc1_w = np.asarray(params["fc1"]["kernel"], np.float32).T  # [128, hwc]
+    fc1_w = (fc1_w.reshape(-1, fh, fw, 64)        # [128, h, w, c]
+             .transpose(0, 3, 1, 2)               # [128, c, h, w]
+             .reshape(fc1_w.shape[0], -1))        # [128, chw-ordered]
+    c1w, c1b = conv(params["conv1"])
+    c2w, c2b = conv(params["conv2"])
+    return {
+        "conv1.weight": c1w, "conv1.bias": c1b,
+        "conv2.weight": c2w, "conv2.bias": c2b,
+        "fc1.weight": fc1_w,
+        "fc1.bias": np.asarray(params["fc1"]["bias"], np.float32),
+        "batchnorm.weight": np.asarray(params["batchnorm"]["scale"],
+                                       np.float32),
+        "batchnorm.bias": np.asarray(params["batchnorm"]["bias"], np.float32),
+        "batchnorm.running_mean": np.asarray(state["batchnorm"]["mean"],
+                                             np.float32),
+        "batchnorm.running_var": np.asarray(state["batchnorm"]["var"],
+                                            np.float32),
+        "batchnorm.num_batches_tracked": np.asarray(0, np.int64),
+        "fc2.weight": np.asarray(params["fc2"]["kernel"], np.float32).T,
+        "fc2.bias": np.asarray(params["fc2"]["bias"], np.float32),
+    }
+
+
+# --------------------------------------------------------------- Llama <-> HF
+
+_LLAMA_BLOCK_MAP = (
+    # (ours, HF suffix, transpose?) — ours [in, out] vs torch [out, in]
+    ("q", "self_attn.q_proj.weight", True),
+    ("k", "self_attn.k_proj.weight", True),
+    ("v", "self_attn.v_proj.weight", True),
+    ("o", "self_attn.o_proj.weight", True),
+    ("gate", "mlp.gate_proj.weight", True),
+    ("up", "mlp.up_proj.weight", True),
+    ("down", "mlp.down_proj.weight", True),
+    ("attn_norm", "input_layernorm.weight", False),
+    ("mlp_norm", "post_attention_layernorm.weight", False),
+)
+
+
+def llama_to_hf_state_dict(params: PyTree) -> dict[str, np.ndarray]:
+    """Framework Llama params -> HF ``LlamaForCausalLM`` state-dict arrays.
+
+    The layer count comes from the stacked blocks themselves (a caller-
+    supplied count could silently truncate, or duplicate the last layer
+    through clamped indexing). Values are numpy (no torch import); wrap in
+    ``torch.from_numpy`` and ``load_state_dict(..., strict=False)`` (HF
+    registers rotary ``inv_freq`` buffers that carry no learned state).
+    """
+    num_layers = int(
+        jax.tree_util.tree_leaves(params["blocks"])[0].shape[0])
+
+    def t(a):
+        return np.asarray(a, np.float32).T.copy()
+
+    sd = {"model.embed_tokens.weight":
+          np.asarray(params["wte"]["embedding"], np.float32),
+          "model.norm.weight": np.asarray(params["norm_f"]["scale"],
+                                          np.float32),
+          "lm_head.weight": t(params["lm_head"]["kernel"])}
+    b = params["blocks"]
+    for i in range(num_layers):
+        pre = f"model.layers.{i}."
+        for ours, suffix, transpose in _LLAMA_BLOCK_MAP:
+            leaf = b[ours]["kernel" if transpose else "scale"][i]
+            sd[pre + suffix] = (t(leaf) if transpose
+                                else np.asarray(leaf, np.float32))
+    return sd
+
+
+def llama_from_hf_state_dict(state_dict: Mapping[str, Any],
+                             config) -> PyTree:
+    """HF ``LlamaForCausalLM`` state_dict -> framework Llama params.
+
+    ``config`` is a ``models.llama.LlamaConfig`` matching the checkpoint's
+    geometry; values may be torch tensors or numpy arrays. Inverse of
+    :func:`llama_to_hf_state_dict` (round-trip bit-exact); logits parity
+    against HF's own forward is pinned in ``tests/test_llama.py``.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    pd = config.param_dtype
+    need = ["model.embed_tokens.weight", "model.norm.weight",
+            "lm_head.weight"]
+    missing = [k for k in need if k not in sd]
+    if missing:
+        raise KeyError(f"state_dict missing Llama keys {missing}")
+
+    def stack(suffix, transpose):
+        per = []
+        for i in range(config.num_layers):
+            key = f"model.layers.{i}.{suffix}"
+            if key not in sd:
+                raise KeyError(f"state_dict missing {key!r}")
+            a = sd[key]
+            per.append(a.T if transpose else a)
+        return jnp.asarray(np.stack(per), pd)
+
+    blocks = {}
+    for ours, suffix, transpose in _LLAMA_BLOCK_MAP:
+        blocks[ours] = {("kernel" if transpose else "scale"):
+                        stack(suffix, transpose)}
+    return {
+        "wte": {"embedding": jnp.asarray(sd["model.embed_tokens.weight"],
+                                         pd)},
+        "blocks": blocks,
+        "norm_f": {"scale": jnp.asarray(sd["model.norm.weight"], pd)},
+        "lm_head": {"kernel": jnp.asarray(sd["lm_head.weight"].T, pd)},
+    }

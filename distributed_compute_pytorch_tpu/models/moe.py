@@ -200,7 +200,9 @@ class MoETransformerConfig:
     lb_weight: float = 0.01
     z_weight: float = 1e-3
     dropout_rate: float = 0.0
-    remat: bool = False            # rematerialise blocks on backward
+    # rematerialise blocks on backward: True/"block" per-block, or "stage"
+    # (per-pipeline-stage tick, the 1F1B memory profile — pipe meshes)
+    remat: bool | str = False
     pipeline_microbatches: int | None = None   # GPipe M (None = pipe size)
     param_dtype: jnp.dtype = jnp.float32
 
@@ -295,12 +297,11 @@ class MoETransformerLM:
             # GPipe path: the pipeline sums aux over layers and averages
             # it over microbatches (exactly the scanned full-batch value
             # for these mean-based metrics when moe_group_size divides the
-            # microbatch's tokens)
-            def block_apply(p, h, rng=None, train=False, manual_axes=()):
-                return self._block_apply(p, h, rng, train, manual_axes)
+            # microbatch's tokens). _block_apply's own signature already
+            # fits the pipeline's block contract.
             zeros = {"lb_loss": 0.0, "z_loss": 0.0, "dropped_fraction": 0.0}
             x, aux = pipeline_blocks(
-                block_apply, params["blocks"], x, mesh,
+                self._block_apply, params["blocks"], x, mesh,
                 num_microbatches=c.pipeline_microbatches, rng=rng,
                 train=train, remat=c.remat, aux_init=zeros)
             lb, z, dr = (aux["lb_loss"], aux["z_loss"],

@@ -51,9 +51,13 @@ def test_llama_rope_shifts_positions():
 def test_llama_matches_hf_transformers():
     """Weight-for-weight logits parity with HF ``transformers``'
     LlamaForCausalLM — pins every convention at once (half-split RoPE,
-    GQA grouping, RMSNorm placement, SwiGLU, untied head)."""
+    GQA grouping, RMSNorm placement, SwiGLU, untied head) through the
+    user-facing export path (``interop.llama_to_hf_state_dict``)."""
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")
+
+    from distributed_compute_pytorch_tpu.interop import (
+        llama_to_hf_state_dict)
 
     cfg = LlamaConfig.tiny()
     model = LlamaLM(cfg)
@@ -70,28 +74,8 @@ def test_llama_matches_hf_transformers():
         attn_implementation="eager")
     hf = transformers.LlamaForCausalLM(hf_cfg).eval()
 
-    def t(a):   # ours [in, out] -> torch Linear weight [out, in]
-        return torch.from_numpy(np.asarray(a, np.float32).T.copy())
-
-    sd = {"model.embed_tokens.weight":
-          torch.from_numpy(np.asarray(params["wte"]["embedding"])),
-          "model.norm.weight":
-          torch.from_numpy(np.asarray(params["norm_f"]["scale"])),
-          "lm_head.weight": t(params["lm_head"]["kernel"])}
-    b = params["blocks"]
-    for i in range(cfg.num_layers):
-        pre = f"model.layers.{i}."
-        sd[pre + "self_attn.q_proj.weight"] = t(b["q"]["kernel"][i])
-        sd[pre + "self_attn.k_proj.weight"] = t(b["k"]["kernel"][i])
-        sd[pre + "self_attn.v_proj.weight"] = t(b["v"]["kernel"][i])
-        sd[pre + "self_attn.o_proj.weight"] = t(b["o"]["kernel"][i])
-        sd[pre + "mlp.gate_proj.weight"] = t(b["gate"]["kernel"][i])
-        sd[pre + "mlp.up_proj.weight"] = t(b["up"]["kernel"][i])
-        sd[pre + "mlp.down_proj.weight"] = t(b["down"]["kernel"][i])
-        sd[pre + "input_layernorm.weight"] = torch.from_numpy(
-            np.asarray(b["attn_norm"]["scale"][i]))
-        sd[pre + "post_attention_layernorm.weight"] = torch.from_numpy(
-            np.asarray(b["mlp_norm"]["scale"][i]))
+    sd = {k: torch.from_numpy(v) for k, v in
+          llama_to_hf_state_dict(params).items()}
     missing, unexpected = hf.load_state_dict(sd, strict=False)
     assert not unexpected, unexpected
     # rotary inv_freq buffers may appear as missing on some versions; no
@@ -105,6 +89,27 @@ def test_llama_matches_hf_transformers():
     ours, _ = model.apply(params, {}, jnp.asarray(toks.astype(np.int32)),
                           train=False)
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_hf_round_trip():
+    """to_hf -> from_hf reproduces the params bit-exactly, so pretrained
+    HF Llama checkpoints load into the framework losslessly."""
+    from distributed_compute_pytorch_tpu.interop import (
+        llama_from_hf_state_dict, llama_to_hf_state_dict)
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaLM(cfg)
+    params, _ = model.init(jax.random.key(4))
+    back = llama_from_hf_state_dict(
+        llama_to_hf_state_dict(params), cfg)
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(back)[0]):
+        assert jax.tree_util.keystr(ka) == jax.tree_util.keystr(kb)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=jax.tree_util.keystr(ka))
+    with pytest.raises(KeyError, match="missing"):
+        llama_from_hf_state_dict({}, cfg)
 
 
 def test_gqa_equals_tiled_mha():
