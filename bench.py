@@ -373,6 +373,16 @@ def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops):
                            jnp.int32),
         batch_sharding(mesh, 2))
     n_params = sum(leaf.size for leaf in jax.tree.leaves(state.params))
+    # ACTIVE params per token (the MoE MFU convention): expert FFNs
+    # ([L, E, ...] leaves under blocks/moe, minus the router) count
+    # top_k/E-ths; everything else is dense
+    expert_params = sum(
+        leaf.size for path, leaf in
+        jax.tree_util.tree_flatten_with_path(state.params)[0]
+        if any(getattr(k, "key", None) == "moe" for k in path)
+        and leaf.ndim >= 2 and leaf.shape[1] == cfg.num_experts)
+    n_active = (n_params - expert_params
+                + expert_params * cfg.top_k // cfg.num_experts)
     # dropped-token fraction from a fresh apply, BEFORE the timed steps
     # donate the state buffers
     (_, aux), _ = jax.jit(
@@ -382,12 +392,19 @@ def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops):
                          s.params), {}, x))(state, x)
     aux = {k: float(v) for k, v in aux.items()}
     dt, finite = _time_steps(np, train_step, state, x, x)
+    flops_per_token = (6 * n_active
+                       + 12 * cfg.num_layers * T * cfg.d_model)
+    mfu = (B * T / dt * flops_per_token / (peak_flops * n_chips)
+           if peak_flops else None)
     return {
         "batch": B, "seq_len": T, "experts": cfg.num_experts,
         "top_k": cfg.top_k, "step_ms": round(dt * 1000, 2),
         "samples_per_sec_per_chip": round(B / dt / n_chips, 2),
         "tokens_per_sec_per_chip": round(B * T / dt / n_chips, 1),
-        "n_params": int(n_params),
+        "n_params": int(n_params), "n_active_params": int(n_active),
+        # MFU against ACTIVE flops — the honest MoE convention (dense MFU
+        # would credit compute the routing deliberately skips)
+        "mfu_active": round(mfu, 4) if mfu is not None else None,
         "dropped_token_fraction": round(float(aux["dropped_fraction"]), 4),
         "loss_finite": finite,
     }

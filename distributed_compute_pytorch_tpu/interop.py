@@ -215,11 +215,23 @@ def llama_from_hf_state_dict(state_dict: Mapping[str, Any],
     """
     sd = {k: _np(v) for k, v in state_dict.items()}
     pd = config.param_dtype
-    need = ["model.embed_tokens.weight", "model.norm.weight",
-            "lm_head.weight"]
+    need = ["model.embed_tokens.weight", "model.norm.weight"]
     missing = [k for k in need if k not in sd]
     if missing:
         raise KeyError(f"state_dict missing Llama keys {missing}")
+    if "lm_head.weight" not in sd:
+        # tied-embedding checkpoints (tie_word_embeddings=True, e.g. the
+        # small open Llama-family models) omit the head; the framework's
+        # head is untied, so materialise it from the embedding
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    extra_layer = f"model.layers.{config.num_layers}."
+    if any(k.startswith(extra_layer) for k in sd):
+        # a too-small num_layers would otherwise silently DROP the
+        # checkpoint's remaining layers and produce garbage logits
+        raise ValueError(
+            f"state_dict has layers beyond config.num_layers="
+            f"{config.num_layers} (found {extra_layer}* keys) — the "
+            f"config does not match the checkpoint")
 
     def stack(suffix, transpose):
         per = []

@@ -13,7 +13,16 @@ FlashAttention recurrence mapped onto the Pallas TPU grid model:
 - causal runs skip fully-masked kv blocks with `@pl.when`, mask the diagonal
   block with broadcasted_iota (guide: 2D iota);
 - backward is the two-kernel split (dQ; dK/dV) using the saved logsumexp
-  and the precomputed row term delta = rowsum(dO * O).
+  and the precomputed row term delta = rowsum(dO * O). A one-pass fused
+  backward (sharing the recomputed score block between dQ and dK/dV) was
+  built and REJECTED: the side whose accumulator is keyed by the inner
+  grid axis must read-modify-write a revisited HBM block, and Pallas's
+  pipelined prefetch fetches the next visit's input block while the
+  previous write is still in flight — a race that corrupted dQ in
+  testing. The split costs 2 extra block matmuls of 7 but every
+  accumulator lives in VMEM scratch across consecutive grid steps,
+  which is the sound TPU schedule (the reference TPU kernels make the
+  same choice).
 
 Block sizes default to 128 (MXU tile). Sequence lengths must divide the
 block size; the public wrapper falls back to the XLA path otherwise.

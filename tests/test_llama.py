@@ -111,6 +111,21 @@ def test_llama_hf_round_trip():
     with pytest.raises(KeyError, match="missing"):
         llama_from_hf_state_dict({}, cfg)
 
+    # tied-embedding checkpoints omit lm_head.weight: imported head ==
+    # embedding (the framework head is untied)
+    sd = llama_to_hf_state_dict(params)
+    del sd["lm_head.weight"]
+    tied = llama_from_hf_state_dict(sd, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(tied["lm_head"]["kernel"]),
+        np.asarray(params["wte"]["embedding"]).T)
+
+    # a config with FEWER layers than the checkpoint must raise, not
+    # silently truncate the network
+    small = dataclasses.replace(cfg, num_layers=cfg.num_layers - 1)
+    with pytest.raises(ValueError, match="beyond config.num_layers"):
+        llama_from_hf_state_dict(llama_to_hf_state_dict(params), small)
+
 
 def test_gqa_equals_tiled_mha():
     """GQA's K/V-head broadcast is exactly an MHA whose K/V projections are
