@@ -105,6 +105,10 @@ class Config:
     # device-side train-time image augmentation (ops/augment.py), traced
     # into the jitted step: none | flip | flip-crop
     augment: str = "none"
+    # --- optimizer extras (train/optim.py) ---
+    weight_decay: float = 0.0      # AdamW decay (matrices only, masked)
+    clip_norm: float = 0.0         # global-grad-norm clip (0 = off)
+    grad_accum: int = 1            # micro-steps accumulated per update
     compile_cache_dir: str | None = field(
         default_factory=lambda: _env("DCP_COMPILE_CACHE"))
                                      # persistent XLA compile cache (skip
@@ -210,6 +214,15 @@ class Config:
                        choices=("none", "flip", "flip-crop"),
                        help="device-side train-time image augmentation "
                             "(traced into the jitted step; image models)")
+        p.add_argument("--weight_decay", type=float, default=cls.weight_decay,
+                       help="AdamW weight decay (matrices only; biases and "
+                            "norm scales are excluded)")
+        p.add_argument("--clip_norm", type=float, default=cls.clip_norm,
+                       help="clip gradients to this global norm (0 = off)")
+        p.add_argument("--grad_accum", type=int, default=cls.grad_accum,
+                       help="accumulate N micro-step gradients per "
+                            "optimizer update (N-times effective batch at "
+                            "constant activation memory)")
         p.add_argument("--compile_cache_dir", type=str, default=None,
                        help="persistent XLA compile cache directory "
                             "(env DCP_COMPILE_CACHE)")

@@ -45,19 +45,71 @@ def adadelta_steplr(lr: float, gamma: float, steps_per_epoch: int,
     )
 
 
+# weight leaves that DO decay, by the framework's own naming convention
+# (models/layers.py, models/moe.py): kernels, embeddings, and the MoE
+# expert weight tensors. Everything else — "bias", "scale", MoE "b_in"/
+# "b_out" — is a (possibly stacked) vector and is excluded.
+_DECAY_LEAF_NAMES = frozenset({"kernel", "embedding", "w_in", "w_out"})
+
+
+def decay_mask(params):
+    """Standard AdamW decay exclusion: weight matrices decay; biases and
+    norm scales don't. Keyed by LEAF NAME, not rank — stacked block
+    layouts give vectors extra leading dims ([L, d] ln scales,
+    [L, E, f] MoE expert biases) that a rank threshold misclassifies."""
+    import jax
+
+    def keep(path, leaf):
+        del leaf
+        name = getattr(path[-1], "key", None)
+        return name in _DECAY_LEAF_NAMES
+
+    return jax.tree_util.tree_map_with_path(keep, params)
+
+
 def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
                     weight_decay: float = 0.0, warmup_steps: int = 0,
+                    clip_norm: float = 0.0, grad_accum: int = 1,
                     **kw) -> optax.GradientTransformation:
     """Registry for the model ladder: the reference stack for parity runs,
-    AdamW+warmup-cosine for the transformer rungs."""
+    AdamW+warmup-cosine for the transformer rungs.
+
+    ``clip_norm``: global-gradient-norm clip (0 = off), applied before the
+    optimizer. ``grad_accum``: accumulate N micro-step gradients before
+    each parameter update (``optax.MultiSteps``) — N-times the effective
+    batch at constant activation memory. Neither composes with
+    ``adamw_fused`` (its single-pass kernel bypasses the update chain).
+    """
     total = kw.pop("total_steps", steps_per_epoch * 10)
+    if name == "adamw_fused" and (clip_norm > 0 or grad_accum > 1
+                                  or weight_decay > 0):
+        raise ValueError(
+            "adamw_fused bypasses the optax update chain (and its kernel "
+            "has no decay-mask path, so weight_decay would hit biases and "
+            "norm scales too); use --optimizer adamw with "
+            "--clip_norm/--grad_accum/--weight_decay")
+    if grad_accum > 1:
+        # schedules are indexed by UPDATE count: MultiSteps advances the
+        # inner transformation once per accumulated update, so horizons
+        # given in feeder micro-steps must shrink by the accumulation
+        # factor or warmup/decay would run grad_accum-times slow
+        steps_per_epoch = max(1, steps_per_epoch // grad_accum)
+        total = max(1, total // grad_accum)
+
+    def wrap(tx):
+        if clip_norm > 0:
+            tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+        if grad_accum > 1:
+            tx = optax.MultiSteps(tx, every_k_schedule=grad_accum)
+        return tx
+
     if name == "adadelta":
-        return adadelta_steplr(lr, gamma, steps_per_epoch, **kw)
+        return wrap(adadelta_steplr(lr, gamma, steps_per_epoch, **kw))
     if name == "sgd":
-        return optax.chain(
+        return wrap(optax.chain(
             optax.trace(decay=kw.pop("momentum", 0.9)),
             optax.scale_by_schedule(lambda s: -steplr(lr, gamma, steps_per_epoch)(s)),
-        )
+        ))
     if name in ("adamw", "adamw_fused"):
         sched = optax.warmup_cosine_decay_schedule(
             init_value=0.0, peak_value=lr,
@@ -69,5 +121,9 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
             from distributed_compute_pytorch_tpu.ops.pallas.fused_adamw import (
                 fused_adamw)
             return fused_adamw(sched, weight_decay=weight_decay, **kw)
-        return optax.adamw(sched, weight_decay=weight_decay, **kw)
+        # matrices decay, vectors (biases/norm scales) don't — the
+        # standard AdamW exclusion
+        return wrap(optax.adamw(sched, weight_decay=weight_decay,
+                                mask=decay_mask if weight_decay else None,
+                                **kw))
     raise ValueError(f"unknown optimizer {name!r}")

@@ -95,7 +95,9 @@ class Trainer:
         self.tx = build_optimizer(
             config.optimizer, config.lr, config.gamma,
             steps_per_epoch=self.train_feed.steps_per_epoch,
-            total_steps=self.train_feed.steps_per_epoch * config.epochs)
+            total_steps=self.train_feed.steps_per_epoch * config.epochs,
+            weight_decay=config.weight_decay, clip_norm=config.clip_norm,
+            grad_accum=config.grad_accum)
         compute_dtype = (None if config.compute_dtype in (None, "float32")
                          else jnp.dtype(config.compute_dtype))
         augment = None

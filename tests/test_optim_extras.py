@@ -1,0 +1,147 @@
+"""Optimizer extras (train/optim.py): global-norm clipping, masked weight
+decay, and gradient accumulation — semantics plus full-step integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.train.optim import (
+    build_optimizer, decay_mask)
+from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+
+def test_decay_mask_matrices_only():
+    params = {
+        "wte": {"embedding": jnp.zeros((8, 4))},          # matrix: decay
+        "blocks": {"qkv": {"kernel": jnp.zeros((2, 4, 12)),   # stacked mat
+                           "bias": jnp.zeros((2, 12))},       # stacked vec
+                   "ln1": {"scale": jnp.zeros((2, 4))},       # stacked vec
+                   # MoE expert leaves: weights decay, biases don't even
+                   # though their stacked shape [L, E, f] is rank-3
+                   "moe": {"w_in": jnp.zeros((2, 4, 4, 8)),
+                           "b_in": jnp.zeros((2, 4, 8))}},
+        "head": {"kernel": jnp.zeros((4, 8)),
+                 "bias": jnp.zeros((8,))},
+    }
+    m = decay_mask(params)
+    assert m["wte"]["embedding"] is True
+    assert m["blocks"]["qkv"]["kernel"] is True
+    assert m["blocks"]["qkv"]["bias"] is False     # [L, d] = per-layer vector
+    assert m["blocks"]["ln1"]["scale"] is False
+    assert m["blocks"]["moe"]["w_in"] is True
+    assert m["blocks"]["moe"]["b_in"] is False
+    assert m["head"]["kernel"] is True
+    assert m["head"]["bias"] is False
+
+
+def test_weight_decay_skips_vectors():
+    """With a huge decay and zero gradients, matrices shrink and vectors
+    are untouched."""
+    tx = build_optimizer("adamw", lr=0.1, gamma=1.0, steps_per_epoch=10,
+                         weight_decay=1.0, total_steps=100)
+    params = {"blocks": {"ln1": {"scale": jnp.ones((2, 4))}},
+              "head": {"kernel": jnp.ones((4, 4))}}
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    for _ in range(3):
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    np.testing.assert_array_equal(
+        np.asarray(params["blocks"]["ln1"]["scale"]), 1.0)
+    assert float(jnp.abs(params["head"]["kernel"]).max()) < 1.0
+
+
+def test_clip_norm_bounds_update():
+    """An enormous gradient produces a bounded first SGD step when clipped."""
+    tx = build_optimizer("sgd", lr=1.0, gamma=1.0, steps_per_epoch=10,
+                         clip_norm=1.0, momentum=0.0)
+    params = {"w": jnp.zeros((4,))}
+    state = tx.init(params)
+    g = {"w": jnp.full((4,), 1e6)}
+    updates, _ = tx.update(g, state, params)
+    # clipped to global norm 1: each of 4 equal entries is 1/2
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.5, rtol=1e-5)
+
+
+def test_grad_accum_equals_big_batch(devices8):
+    """N accumulation micro-steps over N batch shards == one step on the
+    full batch (same SGD update, scaled means)."""
+    mesh = make_mesh("data=8", devices=devices8)
+    model = GPT2(GPT2Config.tiny())
+    data = synthetic_lm(64, seq_len=16, vocab=256, seed=3)
+
+    def run(batch, accum, n_feeds):
+        tx = build_optimizer("sgd", lr=0.1, gamma=1.0, steps_per_epoch=10,
+                             momentum=0.0, grad_accum=accum)
+        feed = DeviceFeeder(data, mesh, batch, shuffle=False)
+        init_fn, train_step, _ = make_step_fns(model, tx, mesh)
+        state = init_fn(jax.random.key(0))
+        batches = list(feed.epoch(0))[:n_feeds]
+        for x, y in batches:
+            state, m = train_step(state, x, y)
+        return jax.device_get(state.params)
+
+    p_big = run(batch=64, accum=1, n_feeds=1)
+    p_acc = run(batch=32, accum=2, n_feeds=2)
+    for a, b in zip(jax.tree_util.tree_leaves(p_big),
+                    jax.tree_util.tree_leaves(p_acc)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_fused_adamw_rejects_extras():
+    with pytest.raises(ValueError, match="adamw_fused"):
+        build_optimizer("adamw_fused", lr=1e-3, gamma=1.0,
+                        steps_per_epoch=10, clip_norm=1.0)
+    with pytest.raises(ValueError, match="adamw_fused"):
+        build_optimizer("adamw_fused", lr=1e-3, gamma=1.0,
+                        steps_per_epoch=10, grad_accum=4)
+    with pytest.raises(ValueError, match="decay-mask"):
+        build_optimizer("adamw_fused", lr=1e-3, gamma=1.0,
+                        steps_per_epoch=10, weight_decay=0.01)
+
+
+def test_grad_accum_schedule_counts_updates_not_microsteps():
+    """With accumulation, LR schedules advance per UPDATE: the same run
+    expressed as (N micro-steps, accum N) must land on the same LR
+    trajectory as (steps, accum 1) — here via steplr's epoch decay."""
+    params = {"w": jnp.ones((4, 4))}
+
+    def lr_after(tx, micro_steps):
+        state = tx.init(params)
+        p = params
+        g = {"w": jnp.ones((4, 4))}
+        for _ in range(micro_steps):
+            updates, state = tx.update(g, state, p)
+            p = optax.apply_updates(p, updates)
+        return np.asarray(p["w"])
+
+    plain = build_optimizer("sgd", lr=0.1, gamma=0.5, steps_per_epoch=2,
+                            momentum=0.0)
+    accum = build_optimizer("sgd", lr=0.1, gamma=0.5, steps_per_epoch=4,
+                            momentum=0.0, grad_accum=2)
+    # 4 plain updates over 2-step epochs == 8 accum micro-steps (4
+    # updates) over 4-micro-step epochs: same decayed-LR trajectory
+    np.testing.assert_allclose(lr_after(accum, 8), lr_after(plain, 4),
+                               rtol=1e-6)
+
+
+def test_trainer_cli_knobs(tmp_path):
+    """--weight_decay/--clip_norm/--grad_accum end-to-end through fit()."""
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    data = synthetic_lm(64, seq_len=16, vocab=256, seed=5)
+    cfg = Config(batch_size=16, lr=1e-3, epochs=2, mesh="data=8",
+                 model="gpt2", model_preset="tiny", dataset="synthetic-lm",
+                 optimizer="adamw", weight_decay=0.01, clip_norm=1.0,
+                 grad_accum=2, ckpt_path=str(tmp_path / "ck.npz"))
+    t = Trainer(cfg, train_data=data, eval_data=data)
+    res = t.fit()
+    assert np.isfinite(res["loss"])
