@@ -109,6 +109,7 @@ class Config:
     weight_decay: float = 0.0      # AdamW decay (matrices only, masked)
     clip_norm: float = 0.0         # global-grad-norm clip (0 = off)
     grad_accum: int = 1            # micro-steps accumulated per update
+    warmup_steps: int = 0          # LR warmup updates (adamw schedule)
     compile_cache_dir: str | None = field(
         default_factory=lambda: _env("DCP_COMPILE_CACHE"))
                                      # persistent XLA compile cache (skip
@@ -223,6 +224,9 @@ class Config:
                        help="accumulate N micro-step gradients per "
                             "optimizer update (N-times effective batch at "
                             "constant activation memory)")
+        p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps,
+                       help="LR warmup updates for the adamw "
+                            "warmup-cosine schedule")
         p.add_argument("--compile_cache_dir", type=str, default=None,
                        help="persistent XLA compile cache directory "
                             "(env DCP_COMPILE_CACHE)")

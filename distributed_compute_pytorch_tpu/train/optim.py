@@ -93,6 +93,16 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
         # inner transformation once per accumulated update, so horizons
         # given in feeder micro-steps must shrink by the accumulation
         # factor or warmup/decay would run grad_accum-times slow
+        if steps_per_epoch % grad_accum or total % grad_accum:
+            import warnings
+            warnings.warn(
+                f"grad_accum={grad_accum} does not divide "
+                f"steps_per_epoch={steps_per_epoch} / "
+                f"total_steps={total}: accumulation windows span epoch "
+                f"boundaries and the floor-divided schedule horizons "
+                f"drift from the intended decay trajectory; pick a "
+                f"batch/accum combination that divides evenly for exact "
+                f"scheduling", stacklevel=2)
         steps_per_epoch = max(1, steps_per_epoch // grad_accum)
         total = max(1, total // grad_accum)
 

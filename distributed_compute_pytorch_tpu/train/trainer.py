@@ -97,7 +97,7 @@ class Trainer:
             steps_per_epoch=self.train_feed.steps_per_epoch,
             total_steps=self.train_feed.steps_per_epoch * config.epochs,
             weight_decay=config.weight_decay, clip_norm=config.clip_norm,
-            grad_accum=config.grad_accum)
+            grad_accum=config.grad_accum, warmup_steps=config.warmup_steps)
         compute_dtype = (None if config.compute_dtype in (None, "float32")
                          else jnp.dtype(config.compute_dtype))
         augment = None

@@ -141,7 +141,8 @@ def test_trainer_cli_knobs(tmp_path):
     cfg = Config(batch_size=16, lr=1e-3, epochs=2, mesh="data=8",
                  model="gpt2", model_preset="tiny", dataset="synthetic-lm",
                  optimizer="adamw", weight_decay=0.01, clip_norm=1.0,
-                 grad_accum=2, ckpt_path=str(tmp_path / "ck.npz"))
+                 grad_accum=2, warmup_steps=2,
+                 ckpt_path=str(tmp_path / "ck.npz"))
     t = Trainer(cfg, train_data=data, eval_data=data)
     res = t.fit()
     assert np.isfinite(res["loss"])
