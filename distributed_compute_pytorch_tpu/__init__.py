@@ -14,18 +14,25 @@ trainer, see ``/root/reference/main.py``), redesigned TPU-first:
 - Collective metric aggregation happens device-side inside the jitted step
   (reference ``dist.all_reduce``, ``main.py:65,90,91``).
 
-Subpackages
------------
+Subpackages / modules
+---------------------
 core      mesh/topology, distributed init, configuration
-data      dataset readers, sharded sampling, device feeding
-models    layer library and model zoo (ConvNet, ResNet, BERT, GPT-2)
-ops       numerical ops and Pallas TPU kernels
-parallel  partition strategies (DP, FSDP, TP, sequence/ring attention)
-train     trainer loop, optimizer/schedule, metrics, checkpointing
-utils     logging, timing
+data      dataset readers, sharded sampling, streaming shards, device feeding
+models    layer library and model zoo (ConvNet, ResNet-18/50, BERT, GPT-2,
+          Llama, Switch/GShard MoE)
+ops       numerical ops: attention dispatch, rotary embeddings, device-side
+          augmentation, Pallas TPU kernels (flash attention, fused AdamW)
+parallel  partition strategies (DP, FSDP, TP, GPipe pipeline, ring
+          attention, expert parallelism — all composable by mesh axes)
+train     trainer loop, optimizer/schedule, metrics, checkpointing, elastic
+infer     KV-cache autoregressive generation (``generate``)
+interop   torch/HF checkpoint portability, both directions
+utils     logging, timing, atomic filesystem writes
 """
 
 __version__ = "0.1.0"
 
 from distributed_compute_pytorch_tpu.core.config import Config  # noqa: F401
 from distributed_compute_pytorch_tpu.core.mesh import MeshSpec, make_mesh  # noqa: F401
+from distributed_compute_pytorch_tpu.infer import (  # noqa: F401
+    generate, make_generate_fn)
