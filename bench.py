@@ -451,10 +451,12 @@ def _bench_eval(jax, jnp, np, mesh, n_chips):
     }
 
 
-def _bench_decode(jax, jnp, np, mesh, n_chips):
-    """GPT-2-small KV-cache decode throughput (the inference path the
-    reference never had): 16 sequences/chip, prompt 128, greedy, bf16
-    params, batch sharded over the data axis so every chip decodes.
+def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2"):
+    """KV-cache decode throughput (the inference path the reference never
+    had): 16 sequences/chip, prompt 128, greedy, bf16 params, batch
+    sharded over the data axis so every chip decodes. ``which`` picks the
+    family — the Llama entry shows what GQA buys at decode time (4 kv
+    heads vs GPT-2's 12 = a third of the cache bandwidth per tick).
 
     Timed as wall(prompt+256 new) - wall(prompt+128 new) over the extra
     128 ticks — the difference cancels BOTH the prefill cost and the
@@ -462,11 +464,18 @@ def _bench_decode(jax, jnp, np, mesh, n_chips):
     time."""
     from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
     from distributed_compute_pytorch_tpu.infer import make_generate_fn
-    from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
 
     B, T0 = 16 * n_chips, 128
-    cfg = GPT2Config(dropout_rate=0.0)
-    model = GPT2(cfg)
+    if which == "llama":
+        from distributed_compute_pytorch_tpu.models.llama import (
+            LlamaConfig, LlamaLM)
+        cfg = LlamaConfig()
+        model = LlamaLM(cfg)
+    else:
+        from distributed_compute_pytorch_tpu.models.gpt2 import (
+            GPT2, GPT2Config)
+        cfg = GPT2Config(dropout_rate=0.0)
+        model = GPT2(cfg)
     params, _ = model.init(jax.random.key(0))
     params = jax.tree.map(lambda p: p.astype(jnp.bfloat16)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
@@ -606,6 +615,7 @@ def main():
     moe = _stage(_bench_moe, jax, jnp, np, mesh, n_chips, peak)
     ev = _stage(_bench_eval, jax, jnp, np, mesh, n_chips)
     dec = _stage(_bench_decode, jax, jnp, np, mesh, n_chips)
+    dec_ll = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "llama")
     attn = _stage(_bench_attention, jax, jnp, np)
 
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -629,6 +639,7 @@ def main():
             "moe_8e_top2_bf16_t1024": moe,
             "gpt2_eval_bf16_t1024": ev,
             "gpt2_decode_kvcache_bf16": dec,
+            "llama_decode_kvcache_gqa_bf16": dec_ll,
             "flash_vs_dense_attention_bf16": attn,
             # pipeline parallelism needs >1 device; its bubble is
             # quantified on the faked 8-device mesh in
