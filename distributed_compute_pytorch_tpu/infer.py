@@ -31,6 +31,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -42,8 +43,15 @@ def _num_layers(stacked) -> int:
     return int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
 
 
-def prefill(model, params, prompt, t_max: int):
+def prefill(model, params, prompt, t_max: int, prompt_mask=None):
     """Run the prompt through the blocks, filling fresh decode caches.
+
+    ``prompt_mask`` (``[B, T0]``, 1 = real token) supports LEFT-padded
+    variable-length prompts: pad slots are excluded from attention
+    (``kv_mask``) and, for learned-position models, every row embeds its
+    own logical positions (``max(slot - pad_count, 0)``). With left
+    padding the last slot is every row's last real token, so the returned
+    logits are valid for all rows.
 
     Returns ``(last_logits [B, vocab], caches)`` where ``caches`` is a
     list of per-layer ``{"k","v"}: [B, Hk, t_max, hd]`` (prompt K/V
@@ -53,12 +61,19 @@ def prefill(model, params, prompt, t_max: int):
     assert T0 <= t_max, (T0, t_max)
     hk, hd = model.kv_cache_spec()
     block = model._block()
-    x = model.embed(params, prompt, jnp.arange(T0))
+    if prompt_mask is None:
+        positions = jnp.arange(T0)
+    else:
+        pad_count = T0 - jnp.sum(prompt_mask.astype(jnp.int32), axis=1)
+        positions = jnp.maximum(jnp.arange(T0)[None, :]
+                                - pad_count[:, None], 0)
+    x = model.embed(params, prompt, positions)
     dtype = x.dtype
     caches = []
     for i in range(_num_layers(params["blocks"])):
         sink: list = []
-        x = block.apply(_per_layer(params["blocks"], i), x, kv_sink=sink)
+        x = block.apply(_per_layer(params["blocks"], i), x, kv_sink=sink,
+                        kv_mask=prompt_mask)
         (k, v), = sink
         pad = lambda a: lax.dynamic_update_slice_in_dim(
             jnp.zeros((B, hk, t_max, hd), dtype), a.astype(dtype), 0, axis=2)
@@ -86,23 +101,42 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     block = model._block()
 
-    @partial(jax.jit, static_argnames=("_tmax",))
-    def _generate(params, prompt, rng, _tmax):
+    @partial(jax.jit, static_argnames=("_tmax", "_masked"))
+    def _generate(params, prompt, rng, _tmax, _masked, prompt_mask):
         if max_new_tokens == 0:        # static: prefill-only no-op
             return prompt
         B, T0 = prompt.shape
-        last_logits, caches = prefill(model, params, prompt, _tmax)
+        last_logits, caches = prefill(
+            model, params, prompt, _tmax,
+            prompt_mask=prompt_mask if _masked else None)
+        if _masked:
+            pad_count = T0 - jnp.sum(prompt_mask.astype(jnp.int32), axis=1)
+            slot_mask = jnp.concatenate(
+                [prompt_mask.astype(jnp.float32),
+                 jnp.ones((B, _tmax - T0), jnp.float32)], axis=1)
+        else:
+            pad_count = slot_mask = None
         rng, sub = jax.random.split(rng)   # use-once keys: fresh half here
         first = _sample(last_logits, temperature, sub)
 
         def tick(carry, i):
             tok, caches, rng = carry
-            pos = T0 + i                       # position being written
-            x = model.embed(params, tok[:, None], jnp.atleast_1d(pos))
+            pos = T0 + i                       # cache slot being written
+            # per-row LOGICAL position for the learned-position embed
+            # (left-pads shift each row's indices down by its pad count).
+            # Blocks keep SLOT positions for rotary embeddings: the cached
+            # keys were roped at their slots, and RoPE scores depend only
+            # on slot DIFFERENCES, which equal logical differences under
+            # left padding — mixing logical q against slot-roped keys
+            # would skew offsets by pad_count.
+            positions = (jnp.atleast_1d(pos) if not _masked
+                         else (pos - pad_count)[:, None])
+            x = model.embed(params, tok[:, None], positions)
             new_caches = []
             for li, c in enumerate(caches):
                 x, c2 = block.decode_step(
-                    _per_layer(params["blocks"], li), x, c, pos)
+                    _per_layer(params["blocks"], li), x, c, pos,
+                    slot_mask=slot_mask)
                 new_caches.append(c2)
             logits = model.readout(params, x)[:, -1]
             rng, sub = jax.random.split(rng)
@@ -117,7 +151,7 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
         return jnp.concatenate(
             [prompt, first[:, None], toks.transpose(1, 0)], axis=1)
 
-    def generate(params, prompt, rng=None):
+    def generate(params, prompt, rng=None, prompt_mask=None):
         rng = jax.random.key(0) if rng is None else rng
         tm = t_max or (prompt.shape[1] + max_new_tokens)
         if prompt.shape[1] + max_new_tokens > tm:
@@ -135,14 +169,40 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
             raise ValueError(
                 f"prompt ({prompt.shape[1]}) + {max_new_tokens} new tokens "
                 f"exceeds the model's max_seq_len={model_cap}")
-        return _generate(params, prompt, rng, tm)
+        if prompt_mask is not None:
+            m = np.asarray(prompt_mask)
+            if m.shape != tuple(prompt.shape):
+                raise ValueError(f"prompt_mask shape {m.shape} != prompt "
+                                 f"shape {tuple(prompt.shape)}")
+            if not ((m == 0) | (m == 1)).all():
+                # fractional values would split: int-cast pad_count counts
+                # them as pads while the bool attention masks attend them
+                raise ValueError("prompt_mask must be binary (0/1)")
+            if not (m[:, 1:] >= m[:, :-1]).all():
+                # pads-then-tokens per row: generation appends at the END,
+                # so right-padded rows would interleave pads into the
+                # decoded sequence
+                raise ValueError("prompt_mask must be LEFT-padded "
+                                 "(zeros before ones in every row)")
+            if not (m[:, -1] == 1).all():
+                raise ValueError("prompt_mask has fully-padded rows (or "
+                                 "trailing pads); every row needs at "
+                                 "least its final slot real")
+        return _generate(params, prompt, rng, tm,
+                         prompt_mask is not None, prompt_mask)
 
     generate._jitted = _generate   # exposed for cache/retrace inspection
     return generate
 
 
 def generate(model, params, prompt, max_new_tokens: int, *,
-             t_max: int | None = None, temperature: float = 0.0, rng=None):
-    """One-shot convenience wrapper around :func:`make_generate_fn`."""
+             t_max: int | None = None, temperature: float = 0.0, rng=None,
+             prompt_mask=None):
+    """One-shot convenience wrapper around :func:`make_generate_fn`.
+
+    ``prompt_mask`` (``[B, T0]``, 1 = real) enables LEFT-padded
+    variable-length prompt batches.
+    """
     return make_generate_fn(model, max_new_tokens, t_max=t_max,
-                            temperature=temperature)(params, prompt, rng)
+                            temperature=temperature)(
+        params, prompt, rng, prompt_mask=prompt_mask)

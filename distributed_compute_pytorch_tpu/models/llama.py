@@ -149,12 +149,17 @@ class LlamaBlock:
                                                  A.merge_heads(o))
         return self._mlp(params, x)
 
-    def decode_step(self, params, x, cache, pos):
-        """One KV-cached decode tick: ``x [B, 1, d]`` at position ``pos``.
+    def decode_step(self, params, x, cache, pos, slot_mask=None):
+        """One KV-cached decode tick: ``x [B, 1, d]`` at cache slot
+        ``pos``.
 
         The cache stays at kv-head width ([B, Hk, T_max, hd]) — GQA's
-        memory/bandwidth saving — and stores POST-rope keys, so each tick
-        rotates only its own position.
+        memory/bandwidth saving — and stores POST-rope keys roped at
+        their SLOT indices. The new query ropes at its slot too: RoPE
+        scores depend only on position differences, and under left
+        padding slot differences equal logical differences, so this is
+        exact for variable-length batches (``slot_mask`` keeps the pad
+        slots unattended).
         """
         c = self.config
         d, hd = c.d_model, c.head_dim
@@ -165,7 +170,8 @@ class LlamaBlock:
                      cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
                  "v": lax.dynamic_update_slice_in_dim(
                      cache["v"], v.astype(cache["v"].dtype), pos, axis=2)}
-        o = A.cached_attention(q, cache["k"], cache["v"], pos)
+        o = A.cached_attention(q, cache["k"], cache["v"], pos,
+                               slot_mask=slot_mask)
         x = x + dense(c.num_heads * hd, d).apply(params["o"],
                                                  A.merge_heads(o))
         return self._mlp(params, x), cache

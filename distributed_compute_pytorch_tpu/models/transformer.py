@@ -163,13 +163,16 @@ class TransformerBlock:
             x = ln2.apply(params["ln2"], x + self._mlp(params, x, r2, train))
         return x
 
-    def decode_step(self, params, x, cache, pos):
+    def decode_step(self, params, x, cache, pos, slot_mask=None):
         """One KV-cached decode tick: ``x [B, 1, d]`` at position ``pos``.
 
+        This block has no rotary embedding — GPT-2's (possibly per-row)
+        learned positions enter through the model's ``embed``.
+
         Writes this step's K/V into ``cache`` (``{"k","v"}: [B, H, T_max,
-        hd]``) and attends over slots ``0..pos``. Pre-LN causal blocks
-        only — post-LN blocks are bidirectional (BERT) and have no
-        autoregressive decode.
+        hd]``) and attends over slots ``0..pos`` (minus ``slot_mask``-
+        invalid pad slots). Pre-LN causal blocks only — post-LN blocks
+        are bidirectional (BERT) and have no autoregressive decode.
         """
         assert self.causal and self.pre_ln, "decode needs a causal pre-LN block"
         d = self.d_model
@@ -183,7 +186,8 @@ class TransformerBlock:
                      cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
                  "v": lax.dynamic_update_slice_in_dim(
                      cache["v"], v.astype(cache["v"].dtype), pos, axis=2)}
-        o = A.cached_attention(q, cache["k"], cache["v"], pos)
+        o = A.cached_attention(q, cache["k"], cache["v"], pos,
+                               slot_mask=slot_mask)
         x = x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o))
         h = L.LayerNorm(d).apply(params["ln2"], x)
         return x + self._mlp(params, h, None, False), cache

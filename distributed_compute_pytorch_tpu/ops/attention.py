@@ -109,7 +109,8 @@ def attention(q, k, v, *, causal: bool = False, scale: float | None = None,
                                  mask=mask)
 
 
-def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None):
+def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
+                     slot_mask=None):
     """Single-position decode attention over a preallocated K/V cache.
 
     Args:
@@ -120,6 +121,9 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None):
         cache itself stays at kv-head width (the whole point of GQA:
         cache memory and bandwidth scale with ``Hk``).
       pos: scalar position of ``q``; cache slots beyond it are masked.
+      slot_mask: optional ``[B, T_max]`` per-row slot validity (0/1 or
+        bool) — left-padded variable-length prompts leave pad slots in
+        the cache, which must never be attended.
 
     GQA reads the NARROW cache directly: the query's group dim folds into
     its (length-1) sequence dim, so no ``[B, H, T_max, hd]`` repeat is
@@ -135,6 +139,9 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None):
         assert q_len == 1, "GQA cache read expects single-position queries"
         q = q.reshape(B, hk, (H // hk) * q_len, hd)
     valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, None, None, :]
+    if slot_mask is not None:
+        valid = jnp.logical_and(valid,
+                                slot_mask[:, None, None, :].astype(bool))
     out = dot_product_attention(q, k_cache, v_cache, mask=valid,
                                 scale=scale)
     return out.reshape(B, H, q_len, hd) if grouped else out

@@ -23,15 +23,17 @@ import jax.numpy as jnp
 
 
 def rope_cos_sin(positions, head_dim: int, theta: float = 10000.0):
-    """``cos, sin`` tables ``[T, head_dim]`` for integer ``positions [T]``.
+    """``cos, sin`` tables for integer ``positions`` of shape ``[T]``
+    (shared across the batch) or ``[B, T]`` (per-row — left-padded
+    variable-length decoding gives every row its own logical positions).
 
     Frequencies follow ``theta ** (-2i/d)`` for the first ``d/2`` feature
-    pairs; each table duplicates its ``[T, d/2]`` half so the rotation is
-    a plain elementwise multiply against the half-split layout.
+    pairs; each table duplicates its ``d/2`` half so the rotation is a
+    plain elementwise multiply against the half-split layout.
     """
     half = head_dim // 2
     inv_freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq
     cos = jnp.concatenate([jnp.cos(freqs), jnp.cos(freqs)], axis=-1)
     sin = jnp.concatenate([jnp.sin(freqs), jnp.sin(freqs)], axis=-1)
     return cos, sin
@@ -43,12 +45,17 @@ def _rotate_half(x):
 
 
 def apply_rope(x, positions, theta: float = 10000.0):
-    """Rotate ``x [B, H, T, hd]`` by its positions ``[T]`` (int).
+    """Rotate ``x [B, H, T, hd]`` by integer ``positions`` — ``[T]``
+    (shared) or ``[B, T]`` (per-row).
 
     ``positions`` may be traced (the pipeline's seq-manual path offsets
     them by ``axis_index('seq') * chunk``).
     """
     cos, sin = rope_cos_sin(positions, x.shape[-1], theta)
+    if cos.ndim == 3:              # [B, T, hd] -> broadcast over heads
+        cos, sin = cos[:, None], sin[:, None]
+    else:                          # [T, hd] -> broadcast over batch+heads
+        cos, sin = cos[None, None], sin[None, None]
     x32 = x.astype(jnp.float32)
-    out = x32 * cos[None, None] + _rotate_half(x32) * sin[None, None]
+    out = x32 * cos + _rotate_half(x32) * sin
     return out.astype(x.dtype)

@@ -70,6 +70,62 @@ def test_temperature_sampling_deterministic_per_key():
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
+@pytest.mark.parametrize("name,model", _models())
+def test_left_padded_batch_matches_individual(name, model):
+    """The gold variable-length test: a LEFT-padded batch of different-
+    length prompts generates exactly what each prompt generates alone —
+    pads never leak into attention, and per-row positions line up (GPT-2
+    embeds logical positions; RoPE relies on slot differences, equal to
+    logical differences under left padding)."""
+    params, _ = model.init(jax.random.key(0))
+    T0, N = 10, 6
+    rng = np.random.default_rng(5)
+    lens = [10, 7, 4]
+    rows, mask = [], []
+    for n in lens:
+        toks = rng.integers(0, 256, size=(n,)).astype(np.int32)
+        rows.append(np.concatenate([np.zeros(T0 - n, np.int32), toks]))
+        mask.append(np.concatenate([np.zeros(T0 - n, np.float32),
+                                    np.ones(n, np.float32)]))
+    batch = jnp.asarray(np.stack(rows))
+    mask = jnp.asarray(np.stack(mask))
+
+    out = generate(model, params, batch, N, prompt_mask=mask)
+    for i, n in enumerate(lens):
+        solo = generate(model, params, batch[i:i + 1, T0 - n:], N)
+        np.testing.assert_array_equal(
+            np.asarray(out[i, T0:]), np.asarray(solo[0, n:]),
+            err_msg=f"{name} row {i} (len {n})")
+
+
+def test_left_padded_pad_content_does_not_leak():
+    """Changing token ids under the pad positions must not change the
+    generated continuation."""
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (1, 8), 0, 256)
+    mask = jnp.asarray([[0, 0, 0, 1, 1, 1, 1, 1]], jnp.float32)
+    alt = toks.at[:, :3].set(99)
+    a = generate(model, params, toks, 5, prompt_mask=mask)
+    b = generate(model, params, alt, 5, prompt_mask=mask)
+    np.testing.assert_array_equal(np.asarray(a[:, 8:]), np.asarray(b[:, 8:]))
+
+
+def test_prompt_mask_validation():
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    prompt = jnp.zeros((2, 6), jnp.int32)
+    right_padded = jnp.asarray([[1, 1, 1, 0, 0, 0]] * 2, jnp.float32)
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        generate(model, params, prompt, 2, prompt_mask=right_padded)
+    bad_shape = jnp.ones((2, 5), jnp.float32)
+    with pytest.raises(ValueError, match="shape"):
+        generate(model, params, prompt, 2, prompt_mask=bad_shape)
+    fractional = jnp.asarray([[0, 0.5, 1, 1, 1, 1]] * 2, jnp.float32)
+    with pytest.raises(ValueError, match="binary"):
+        generate(model, params, prompt, 2, prompt_mask=fractional)
+
+
 def test_zero_new_tokens_is_identity():
     model = GPT2(GPT2Config.tiny())
     params, _ = model.init(jax.random.key(0))
