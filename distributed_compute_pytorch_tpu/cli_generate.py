@@ -44,6 +44,9 @@ def main(argv=None) -> int:
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
+    p.add_argument("--eos_id", type=int, default=None,
+                   help="stop a row at this token id (output is trimmed "
+                        "at the first occurrence)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
     args = p.parse_args(argv)
@@ -73,13 +76,19 @@ def main(argv=None) -> int:
     if bad:
         # the embedding gather would CLAMP out-of-range ids silently
         raise SystemExit(f"prompt ids {bad} outside vocab [0, {vocab})")
+    if args.eos_id is not None and not 0 <= args.eos_id < vocab:
+        # an unreachable eos would silently never stop anything
+        raise SystemExit(f"--eos_id {args.eos_id} outside vocab [0, {vocab})")
     prompt = jnp.asarray(ids, jnp.int32)[None, :]
     out = generate(model, params, prompt, args.max_new_tokens,
-                   temperature=args.temperature,
+                   temperature=args.temperature, eos_id=args.eos_id,
                    rng=jax.random.key(args.seed))
     toks = [int(t) for t in out[0]]
-    print(json.dumps({"prompt": ids, "tokens": toks,
-                      "new": toks[len(ids):]}))
+    new = toks[len(ids):]
+    if args.eos_id is not None and args.eos_id in new:
+        new = new[:new.index(args.eos_id) + 1]
+    print(json.dumps({"prompt": ids, "tokens": toks[:len(ids)] + new,
+                      "new": new}))
     return 0
 
 

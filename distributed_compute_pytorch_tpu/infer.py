@@ -19,10 +19,12 @@ Sampling: greedy at ``temperature=0`` else softmax sampling via
 ``jax.random.categorical``; both deterministic given the rng key.
 
 Model contract (``gpt2.py``/``llama.py``): ``embed(params, tokens,
-positions)``, ``readout(params, x)``, ``kv_cache_spec()``, ``_block()``
-with ``apply(..., kv_sink=...)`` and ``decode_step(params, x, cache,
-pos)``. Correctness is pinned by ``tests/test_generate.py``: greedy
-cached generation must equal a full-forward re-run at every step.
+positions)`` (positions may be per-row ``[B, T]``), ``readout(params,
+x)``, ``kv_cache_spec()``, ``_block()`` with ``apply(..., kv_sink=...,
+kv_mask=...)`` and ``decode_step(params, x, cache, pos,
+slot_mask=None)``. Correctness is pinned by ``tests/test_generate.py``:
+greedy cached generation must equal a full-forward re-run at every step,
+and a left-padded batch must equal each prompt generated alone.
 """
 
 from __future__ import annotations
@@ -90,12 +92,15 @@ def _sample(logits, temperature: float, rng):
 
 
 def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
-                     temperature: float = 0.0):
+                     temperature: float = 0.0, eos_id: int | None = None):
     """Build a jitted ``(params, prompt [B, T0], rng) -> tokens
     [B, T0 + max_new_tokens]`` generation function.
 
     ``t_max`` caps the cache length (default ``T0 + max_new_tokens`` at
     trace time); one compilation per (model, prompt-shape, max_new).
+    ``eos_id``: rows that sample this token keep emitting it for the rest
+    of the fixed-shape output (compiled loops cannot shrink; trim at the
+    first eos).
     """
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
@@ -118,9 +123,11 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
             pad_count = slot_mask = None
         rng, sub = jax.random.split(rng)   # use-once keys: fresh half here
         first = _sample(last_logits, temperature, sub)
+        done0 = (jnp.full((B,), False) if eos_id is None
+                 else first == eos_id)
 
         def tick(carry, i):
-            tok, caches, rng = carry
+            tok, caches, rng, done = carry
             pos = T0 + i                       # cache slot being written
             # per-row LOGICAL position for the learned-position embed
             # (left-pads shift each row's indices down by its pad count).
@@ -141,12 +148,17 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
             logits = model.readout(params, x)[:, -1]
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits, temperature, sub)
-            return (nxt, new_caches, rng), nxt
+            if eos_id is not None:
+                # fixed-trip scan: finished rows keep emitting eos (the
+                # compiled shape cannot shrink; callers trim at eos)
+                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                done = jnp.logical_or(done, nxt == eos_id)
+            return (nxt, new_caches, rng, done), nxt
 
         # tick i consumes the token at position T0+i and emits T0+i+1;
         # `first` (position T0) came from prefill, so N-1 ticks complete
         # the N new tokens with no wasted final iteration
-        _, toks = lax.scan(tick, (first, caches, rng),
+        _, toks = lax.scan(tick, (first, caches, rng, done0),
                            jnp.arange(max_new_tokens - 1))
         return jnp.concatenate(
             [prompt, first[:, None], toks.transpose(1, 0)], axis=1)
@@ -197,12 +209,13 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
 
 def generate(model, params, prompt, max_new_tokens: int, *,
              t_max: int | None = None, temperature: float = 0.0, rng=None,
-             prompt_mask=None):
+             prompt_mask=None, eos_id: int | None = None):
     """One-shot convenience wrapper around :func:`make_generate_fn`.
 
     ``prompt_mask`` (``[B, T0]``, 1 = real) enables LEFT-padded
-    variable-length prompt batches.
+    variable-length prompt batches; ``eos_id`` stops rows at that token
+    (they pad the fixed-shape tail with it).
     """
     return make_generate_fn(model, max_new_tokens, t_max=t_max,
-                            temperature=temperature)(
+                            temperature=temperature, eos_id=eos_id)(
         params, prompt, rng, prompt_mask=prompt_mask)

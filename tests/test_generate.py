@@ -126,6 +126,26 @@ def test_prompt_mask_validation():
         generate(model, params, prompt, 2, prompt_mask=fractional)
 
 
+def test_eos_stops_rows():
+    """Once a row samples eos, every later slot holds eos; an eos_id the
+    model never emits leaves the output identical to the eos-free run."""
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, 256)
+
+    base = np.asarray(generate(model, params, prompt, 8))
+    t0 = int(base[0, 6])                   # first generated token, row 0
+    out = np.asarray(generate(model, params, prompt, 8, eos_id=t0))
+    # row 0 hits eos immediately: whole tail is eos
+    assert (out[0, 6:] == t0).all(), out[0, 6:]
+    # rows that never sample the eos match the eos-free run exactly
+    for r in range(2):
+        hit = np.nonzero(base[r, 6:] == t0)[0]
+        cut = 6 + (int(hit[0]) + 1 if hit.size else 8)
+        np.testing.assert_array_equal(out[r, 6:cut], base[r, 6:cut])
+        assert (out[r, cut:] == t0).all() if hit.size else True
+
+
 def test_zero_new_tokens_is_identity():
     model = GPT2(GPT2Config.tiny())
     params, _ = model.init(jax.random.key(0))
