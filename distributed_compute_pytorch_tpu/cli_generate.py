@@ -44,6 +44,12 @@ def main(argv=None) -> int:
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
+    p.add_argument("--top_k", type=int, default=None,
+                   help="sample only among the k highest-probability "
+                        "tokens (temperature > 0)")
+    p.add_argument("--top_p", type=float, default=None,
+                   help="nucleus sampling: smallest token set with "
+                        "cumulative probability >= p (temperature > 0)")
     p.add_argument("--eos_id", type=int, default=None,
                    help="stop a row at this token id (output is trimmed "
                         "at the first occurrence)")
@@ -79,9 +85,15 @@ def main(argv=None) -> int:
     if args.eos_id is not None and not 0 <= args.eos_id < vocab:
         # an unreachable eos would silently never stop anything
         raise SystemExit(f"--eos_id {args.eos_id} outside vocab [0, {vocab})")
+    if args.temperature == 0.0 and (args.top_k is not None
+                                    or args.top_p is not None):
+        # greedy ignores truncation; silence here would mislead
+        raise SystemExit("--top_k/--top_p need --temperature > 0 "
+                         "(sampling); temperature 0 is greedy")
     prompt = jnp.asarray(ids, jnp.int32)[None, :]
     out = generate(model, params, prompt, args.max_new_tokens,
                    temperature=args.temperature, eos_id=args.eos_id,
+                   top_k=args.top_k, top_p=args.top_p,
                    rng=jax.random.key(args.seed))
     toks = [int(t) for t in out[0]]
     new = toks[len(ids):]

@@ -15,8 +15,10 @@ design: everything is ONE compiled program with static shapes —
   No data-dependent python control flow, no per-token dispatch — the
   whole generation is a single device program.
 
-Sampling: greedy at ``temperature=0`` else softmax sampling via
-``jax.random.categorical``; both deterministic given the rng key.
+Sampling: greedy at ``temperature=0``; else softmax sampling via
+``jax.random.categorical``, optionally truncated to the ``top_k``
+highest-probability tokens and/or the smallest set reaching ``top_p``
+cumulative mass (nucleus). All deterministic given the rng key.
 
 Model contract (``gpt2.py``/``llama.py``): ``embed(params, tokens,
 positions)`` (positions may be per-row ``[B, T]``), ``readout(params,
@@ -83,16 +85,33 @@ def prefill(model, params, prompt, t_max: int, prompt_mask=None):
     return model.readout(params, x)[:, -1], caches
 
 
-def _sample(logits, temperature: float, rng):
+def _sample(logits, temperature: float, rng, top_k: int | None = None,
+            top_p: float | None = None):
+    """Greedy at ``temperature=0``; else softmax sampling, optionally
+    truncated to the ``top_k`` highest logits and/or the smallest-mass
+    nucleus reaching ``top_p`` — both static-shape (mask, don't gather)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        rng, logits.astype(jnp.float32) / temperature, axis=-1
-    ).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # keep the smallest prefix of the sorted distribution whose mass
+        # reaches top_p (the first token always stays: shifted cumsum)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1,
+                             keepdims=True) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
-                     temperature: float = 0.0, eos_id: int | None = None):
+                     temperature: float = 0.0, eos_id: int | None = None,
+                     top_k: int | None = None, top_p: float | None = None):
     """Build a jitted ``(params, prompt [B, T0], rng) -> tokens
     [B, T0 + max_new_tokens]`` generation function.
 
@@ -104,6 +123,13 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
     """
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    vocab = getattr(model.config, "vocab_size", None)
+    if top_k is not None and not 1 <= top_k <= (vocab or top_k):
+        raise ValueError(f"top_k must be in [1, vocab={vocab}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # top_p <= 0 would underflow the nucleus cutoff index and silently
+        # sample the FULL vocabulary — the opposite of most-restrictive
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     block = model._block()
 
     @partial(jax.jit, static_argnames=("_tmax", "_masked"))
@@ -122,7 +148,7 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
         else:
             pad_count = slot_mask = None
         rng, sub = jax.random.split(rng)   # use-once keys: fresh half here
-        first = _sample(last_logits, temperature, sub)
+        first = _sample(last_logits, temperature, sub, top_k, top_p)
         done0 = (jnp.full((B,), False) if eos_id is None
                  else first == eos_id)
 
@@ -147,7 +173,7 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                 new_caches.append(c2)
             logits = model.readout(params, x)[:, -1]
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits, temperature, sub)
+            nxt = _sample(logits, temperature, sub, top_k, top_p)
             if eos_id is not None:
                 # fixed-trip scan: finished rows keep emitting eos (the
                 # compiled shape cannot shrink; callers trim at eos)
@@ -209,7 +235,8 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
 
 def generate(model, params, prompt, max_new_tokens: int, *,
              t_max: int | None = None, temperature: float = 0.0, rng=None,
-             prompt_mask=None, eos_id: int | None = None):
+             prompt_mask=None, eos_id: int | None = None,
+             top_k: int | None = None, top_p: float | None = None):
     """One-shot convenience wrapper around :func:`make_generate_fn`.
 
     ``prompt_mask`` (``[B, T0]``, 1 = real) enables LEFT-padded
@@ -217,5 +244,6 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     (they pad the fixed-shape tail with it).
     """
     return make_generate_fn(model, max_new_tokens, t_max=t_max,
-                            temperature=temperature, eos_id=eos_id)(
+                            temperature=temperature, eos_id=eos_id,
+                            top_k=top_k, top_p=top_p)(
         params, prompt, rng, prompt_mask=prompt_mask)

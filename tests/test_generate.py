@@ -126,6 +126,37 @@ def test_prompt_mask_validation():
         generate(model, params, prompt, 2, prompt_mask=fractional)
 
 
+def test_top_k_and_top_p_sampling():
+    """top_k=1 and a tiny top_p both collapse sampling to greedy; wide
+    truncation (top_k=vocab / top_p=1) reproduces plain sampling."""
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 0, 256)
+    key = jax.random.key(11)
+
+    greedy = np.asarray(generate(model, params, prompt, 6))
+    k1 = np.asarray(generate(model, params, prompt, 6, temperature=0.9,
+                             rng=key, top_k=1))
+    np.testing.assert_array_equal(k1, greedy)
+    p_tiny = np.asarray(generate(model, params, prompt, 6, temperature=0.9,
+                                 rng=key, top_p=1e-6))
+    np.testing.assert_array_equal(p_tiny, greedy)
+
+    plain = np.asarray(generate(model, params, prompt, 6, temperature=0.9,
+                                rng=key))
+    k_all = np.asarray(generate(model, params, prompt, 6, temperature=0.9,
+                                rng=key, top_k=256))
+    np.testing.assert_array_equal(k_all, plain)
+
+    # boundary values that would silently misbehave must raise instead
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=0.9, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=0.9, top_k=0)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=0.9, top_k=9999)
+
+
 def test_eos_stops_rows():
     """Once a row samples eos, every later slot holds eos; an eos_id the
     model never emits leaves the output identical to the eos-free run."""
