@@ -110,6 +110,10 @@ class Config:
     clip_norm: float = 0.0         # global-grad-norm clip (0 = off)
     grad_accum: int = 1            # micro-steps accumulated per update
     warmup_steps: int = 0          # LR warmup updates (adamw schedule)
+    # Megatron sequence-parallel activations on tensor>1 meshes: residual
+    # stream's token dim sharded over `tensor` between blocks (transformer
+    # models; numerics-transparent)
+    seq_shard_activations: bool = False
     compile_cache_dir: str | None = field(
         default_factory=lambda: _env("DCP_COMPILE_CACHE"))
                                      # persistent XLA compile cache (skip
@@ -227,6 +231,10 @@ class Config:
         p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps,
                        help="LR warmup updates for the adamw "
                             "warmup-cosine schedule")
+        p.add_argument("--seq_shard_activations", action="store_true",
+                       help="Megatron sequence-parallel activations: shard "
+                            "the residual stream's token dim over `tensor` "
+                            "between transformer blocks (tensor>1 meshes)")
         p.add_argument("--compile_cache_dir", type=str, default=None,
                        help="persistent XLA compile cache directory "
                             "(env DCP_COMPILE_CACHE)")

@@ -205,6 +205,54 @@ def current_mesh() -> Mesh | None:
     return _mesh_stack[-1] if _mesh_stack else None
 
 
+def constrain(x, spec: P):
+    """Pin ``x``'s sharding when a mesh context is active (no-op off-mesh).
+
+    Axes absent from the mesh (or size 1) are dropped from the spec, so
+    callers can name their ideal layout unconditionally. Inside a
+    shard_map manual region (the pipeline runs blocks manual over
+    ``pipe``/``seq``) the constraint is built on the ABSTRACT mesh — it
+    knows which axes are Manual — and may only name still-Auto axes;
+    a constraint on the concrete mesh there is an error.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    am = jax.sharding.get_abstract_mesh()
+    manual = (set() if am is None or am.empty else
+              {n for n, t in zip(am.axis_names, am.axis_types)
+               if t == jax.sharding.AxisType.Manual})
+
+    def clean(entry):
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names
+                         and mesh.shape[a] > 1 and a not in manual)
+            return kept or None
+        return (entry if (entry in mesh.axis_names and mesh.shape[entry] > 1
+                          and entry not in manual) else None)
+
+    cleaned = tuple(clean(a) for a in spec)
+    if all(a is None for a in cleaned):
+        return x
+    target = mesh if not manual else am
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(target, P(*cleaned)))
+
+
+def constrain_seq_parallel(x, manual_axes=(), seq_axis: str = "seq"):
+    """Megatron sequence-parallel activation pin: residual stream
+    ``[B, T, d]`` with the token dim sharded over ``tensor``. Shared by
+    every transformer block family (one policy, one place). No-op inside
+    manual regions (the pipeline owns layout there) and when a ring/seq
+    axis already owns the token dim."""
+    if manual_axes:
+        return x
+    mesh = current_mesh()
+    if mesh is not None and dict(mesh.shape).get(seq_axis, 1) > 1:
+        return x
+    return constrain(x, P(("data", "fsdp"), "tensor", None))
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Sharding for a global batch: leading dim split over the batch axes
     present in ``mesh``, remaining dims replicated. The SPMD analogue of the

@@ -130,6 +130,11 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
         # top_p <= 0 would underflow the nucleus cutoff index and silently
         # sample the FULL vocabulary — the opposite of most-restrictive
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature == 0.0 and (top_k is not None or top_p is not None):
+        # greedy ignores truncation — silently returning greedy output
+        # would mislead a caller who believes they sampled
+        raise ValueError("top_k/top_p require temperature > 0 "
+                         "(temperature 0 is greedy)")
     block = model._block()
 
     @partial(jax.jit, static_argnames=("_tmax", "_masked"))

@@ -48,6 +48,9 @@ class BertConfig:
     remat: bool | str = False      # rematerialise blocks on backward
                                    # (True/"block"; "stage" under pipe)
     unroll_layers: bool = True     # python-loop blocks (see GPT2Config)
+    # Megatron sequence-parallel activations on TP meshes (see
+    # transformer.TransformerBlock.seq_shard_activations)
+    seq_shard_activations: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -64,6 +67,7 @@ class BertMLM:
         c = self.config
         return TransformerBlock(c.d_model, c.num_heads, c.d_ff,
                                 c.dropout_rate, pre_ln=False, causal=False,
+                                seq_shard_activations=c.seq_shard_activations,
                                 param_dtype=c.param_dtype)
 
     def init(self, key):

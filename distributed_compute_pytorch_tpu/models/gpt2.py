@@ -52,6 +52,9 @@ class GPT2Config:
     # stacking (-17% step time on v5e at 12 layers); scan for very deep
     # stacks where compile time binds
     unroll_layers: bool = True
+    # Megatron sequence-parallel activations on TP meshes (see
+    # transformer.TransformerBlock.seq_shard_activations)
+    seq_shard_activations: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -74,6 +77,7 @@ class GPT2:
         c = self.config
         return TransformerBlock(c.d_model, c.num_heads, c.d_ff,
                                 c.dropout_rate, pre_ln=True, causal=True,
+                                seq_shard_activations=c.seq_shard_activations,
                                 param_dtype=c.param_dtype)
 
     def init(self, key):

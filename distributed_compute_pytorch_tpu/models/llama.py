@@ -51,6 +51,9 @@ class LlamaConfig:
     remat: bool | str = False      # True/"block" per-block; "stage" = 1F1B
                                    # memory profile under a pipe mesh
     unroll_layers: bool = True
+    # Megatron sequence-parallel activations on TP meshes (see
+    # transformer.TransformerBlock.seq_shard_activations)
+    seq_shard_activations: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
     def __post_init__(self):
@@ -126,6 +129,15 @@ class LlamaBlock:
                  * dense(c.d_model, c.d_ff).apply(params["up"], h))
         return x + dense(c.d_ff, c.d_model).apply(params["down"], gated)
 
+    def _ssa(self, x, manual_axes):
+        """Megatron sequence-parallel activation pin for TP meshes (see
+        transformer.TransformerBlock.seq_shard_activations)."""
+        if not self.config.seq_shard_activations:
+            return x
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            constrain_seq_parallel)
+        return constrain_seq_parallel(x, manual_axes)
+
     def apply(self, params, x, *, rng=None, train: bool = False,
               kv_mask=None, manual_axes=(), kv_sink=None):
         del rng, train    # the Llama recipe has no dropout
@@ -133,6 +145,7 @@ class LlamaBlock:
         d, hd = c.d_model, c.head_dim
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
 
+        x = self._ssa(x, manual_axes)
         h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
         pos = self._positions(x.shape[1], tuple(manual_axes))
         q, k, v = self._qkv(params, h, pos)
@@ -147,7 +160,7 @@ class LlamaBlock:
                                manual_axes=manual_axes)
         x = x + dense(c.num_heads * hd, d).apply(params["o"],
                                                  A.merge_heads(o))
-        return self._mlp(params, x)
+        return self._mlp(params, self._ssa(x, manual_axes))
 
     def decode_step(self, params, x, cache, pos, slot_mask=None):
         """One KV-cached decode tick: ``x [B, 1, d]`` at cache slot

@@ -41,29 +41,9 @@ from distributed_compute_pytorch_tpu.core.mesh import current_mesh
 from distributed_compute_pytorch_tpu.models import layers as L
 
 
-def _constrain(x, spec: P):
-    """Pin ``x``'s sharding when a mesh context is active (no-op off-mesh).
-
-    Inside a shard_map manual region (the pipeline runs MoE blocks manual
-    over ``pipe``/``seq``), the constraint must be built on the ABSTRACT
-    mesh — it knows which axes are Manual — and may only name the still-
-    Auto axes; a constraint on the concrete mesh there is an error."""
-    mesh = current_mesh()
-    if mesh is None:
-        return x
-    am = jax.sharding.get_abstract_mesh()
-    manual = (set() if am is None or am.empty else
-              {n for n, t in zip(am.axis_names, am.axis_types)
-               if t == jax.sharding.AxisType.Manual})
-    cleaned = tuple(
-        a if (a in mesh.axis_names and mesh.shape[a] > 1
-              and a not in manual) else None
-        for a in spec)
-    if all(a is None for a in cleaned):
-        return x
-    target = mesh if not manual else am
-    return jax.lax.with_sharding_constraint(
-        x, jax.sharding.NamedSharding(target, P(*cleaned)))
+# sharding pin that composes with the pipeline's manual regions (moved to
+# core/mesh.py when activation sharding grew more callers)
+from distributed_compute_pytorch_tpu.core.mesh import constrain as _constrain  # noqa: E402,E501
 
 
 @dataclass(frozen=True)

@@ -116,6 +116,14 @@ class TransformerBlock:
     seq_axis: str = "seq"          # ring attention engages when the current
                                    # mesh has this axis with size > 1
     attn_impl: str = "auto"        # 'auto' = Pallas flash kernel on TPU
+    # Megatron-style sequence-parallel ACTIVATIONS for TP meshes: pin the
+    # residual stream's token dim over `tensor` at the block boundaries,
+    # so XLA lowers the two per-block all-reduces to reduce-scatter +
+    # all-gather pairs and LayerNorm/dropout work is sharded instead of
+    # replicated. Numerics-transparent (== DP, tested); engages only when
+    # the mesh has tensor > 1 and no seq/ring axis competes for the token
+    # dim. Opt-in: on single-chip runs the constraint is a no-op anyway.
+    seq_shard_activations: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -145,6 +153,14 @@ class TransformerBlock:
         h = L.Dense(self.d_ff, self.d_model).apply(params["mlp_out"], h)
         return L.dropout(h, self.dropout_rate, rng, train)
 
+    def _ssa(self, x, manual_axes):
+        """Sequence-parallel activation pin (see the field docstring)."""
+        if not self.seq_shard_activations:
+            return x
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            constrain_seq_parallel)
+        return constrain_seq_parallel(x, manual_axes, self.seq_axis)
+
     def apply(self, params, x, *, rng=None, train: bool = False,
               kv_mask=None, manual_axes=(), kv_sink=None):
         r1 = r2 = None
@@ -152,14 +168,17 @@ class TransformerBlock:
             r1, r2 = jax.random.split(rng)
         ln1 = L.LayerNorm(self.d_model)
         ln2 = L.LayerNorm(self.d_model)
+        x = self._ssa(x, manual_axes)
         if self.pre_ln:
             x = x + self._attn(params, ln1.apply(params["ln1"], x), r1,
                                train, kv_mask, manual_axes, kv_sink)
+            x = self._ssa(x, manual_axes)
             x = x + self._mlp(params, ln2.apply(params["ln2"], x), r2, train)
         else:  # post-LN (BERT)
             x = ln1.apply(params["ln1"],
                           x + self._attn(params, x, r1, train, kv_mask,
                                          manual_axes, kv_sink))
+            x = self._ssa(x, manual_axes)
             x = ln2.apply(params["ln2"], x + self._mlp(params, x, r2, train))
         return x
 

@@ -229,6 +229,12 @@ class Trainer:
         if (cfg.model in ("bert", "gpt2", "llama", "moe")
                 and cfg.microbatches):
             kw["pipeline_microbatches"] = cfg.microbatches
+        if cfg.seq_shard_activations:
+            if cfg.model in ("bert", "gpt2", "llama"):
+                kw["seq_shard_activations"] = True
+            else:
+                log0(f"WARNING: --seq_shard_activations is not supported "
+                     f"by model {cfg.model!r} and will be ignored")
         if cfg.remat:
             if cfg.model in ("bert", "gpt2", "moe", "llama"):
                 stage_ok = (cfg.remat_mode == "stage"
