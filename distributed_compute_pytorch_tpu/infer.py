@@ -130,6 +130,10 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
         # top_p <= 0 would underflow the nucleus cutoff index and silently
         # sample the FULL vocabulary — the opposite of most-restrictive
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature < 0.0:
+        # dividing logits by a negative temperature INVERTS the
+        # distribution (samples the least likely tokens)
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature == 0.0 and (top_k is not None or top_p is not None):
         # greedy ignores truncation — silently returning greedy output
         # would mislead a caller who believes they sampled
