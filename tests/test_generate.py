@@ -235,8 +235,10 @@ def test_restore_params_from_full_checkpoint(tmp_path, devices8):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_cli_generate_end_to_end(tmp_path, capsys, devices8):
-    """dcp-train writes a checkpoint; dcp-generate samples from it."""
+@pytest.mark.parametrize("model_name", ["gpt2", "llama"])
+def test_cli_generate_end_to_end(tmp_path, capsys, devices8, model_name):
+    """dcp-train writes a checkpoint; dcp-generate samples from it — for
+    both causal families through one flow."""
     import json
 
     from distributed_compute_pytorch_tpu.cli_generate import main as gen_main
@@ -247,13 +249,13 @@ def test_cli_generate_end_to_end(tmp_path, capsys, devices8):
     ck = str(tmp_path / "ck.npz")
     data = synthetic_lm(64, seq_len=16, vocab=256, seed=9)
     cfg = Config(batch_size=32, lr=1e-3, epochs=1, mesh="data=8",
-                 model="gpt2", model_preset="tiny", dataset="synthetic-lm",
-                 optimizer="adamw", ckpt_path=ck)
+                 model=model_name, model_preset="tiny",
+                 dataset="synthetic-lm", optimizer="adamw", ckpt_path=ck)
     Trainer(cfg, train_data=data, eval_data=data).fit()
 
     # model config must match the training run (the trainer sized
     # max_seq_len to the dataset); a mismatch raises in restore_params
-    rc = gen_main(["--ckpt_path", ck, "--model", "gpt2",
+    rc = gen_main(["--ckpt_path", ck, "--model", model_name,
                    "--model_preset", "tiny", "--max_seq_len", "16",
                    "--prompt", "5, 9, 12", "--max_new_tokens", "6"])
     assert rc == 0
@@ -263,12 +265,15 @@ def test_cli_generate_end_to_end(tmp_path, capsys, devices8):
     assert out["tokens"][:3] == [5, 9, 12]
     assert all(0 <= t < 256 for t in out["new"])
 
-    # a config that doesn't match the save must raise, not silently load
-    # wrong-shaped weights (v1 now validates shapes like v2 always did)
-    with pytest.raises(ValueError, match="configuration changed"):
-        gen_main(["--ckpt_path", ck, "--model", "gpt2",
-                  "--model_preset", "tiny", "--prompt", "5",
-                  "--max_new_tokens", "2"])
+    if model_name == "gpt2":
+        # a config that doesn't match the save must raise, not silently
+        # load wrong-shaped weights (gpt2's position table pins the shape;
+        # llama has no table, so its mismatch surface is num_layers —
+        # covered in test_llama.py's hf-round-trip test)
+        with pytest.raises(ValueError, match="configuration changed"):
+            gen_main(["--ckpt_path", ck, "--model", "gpt2",
+                      "--model_preset", "tiny", "--prompt", "5",
+                      "--max_new_tokens", "2"])
 
 
 def test_generate_is_one_compiled_program():
@@ -282,3 +287,4 @@ def test_generate_is_one_compiled_program():
     gen(params, p1)
     gen(params, p2)
     assert gen._jitted._cache_size() == 1, gen._jitted._cache_size()
+
