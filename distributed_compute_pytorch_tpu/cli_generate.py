@@ -1,15 +1,21 @@
 """``dcp-generate`` — sample tokens from a trained causal-LM checkpoint.
 
 The inference-side companion of ``dcp-train`` (the reference repo trains
-only; ``/root/reference/main.py`` has no generation path). The framework
-carries no tokenizer (the reference has none either), so prompts and
+only; ``/root/reference/main.py`` has no generation path). Prompts and
 outputs are token-id sequences — the contract every tokenizer-owning
 caller can script against:
 
     dcp-generate --ckpt_path ck.npz --model gpt2 --model_preset tiny \\
         --prompt 12,7,90 --max_new_tokens 16 --temperature 0.8
 
-Prints one JSON line: {"prompt": [...], "tokens": [...], "new": [...]}.
+Several prompts separated by ``;`` form a LEFT-padded batch (each prompt
+decodes exactly as it would alone). ``--mesh`` runs SHARDED generation —
+params restored into the training layout (``parallel.api.pick_strategy``),
+batch over ``data``/``fsdp``, KV cache heads over ``tensor`` — so a
+checkpoint that needed FSDP/TP to train also generates.
+
+Prints one JSON line per prompt: {"prompt": [...], "tokens": [...],
+"new": [...]}.
 """
 
 from __future__ import annotations
@@ -19,14 +25,18 @@ import json
 import sys
 
 
-def _parse_prompt(s: str) -> list[int]:
-    try:
-        ids = [int(t) for t in s.replace(",", " ").split()]
-    except ValueError:
-        raise SystemExit(f"--prompt must be token ids, got {s!r}")
-    if not ids:
-        raise SystemExit("--prompt is empty")
-    return ids
+def _parse_prompts(s: str) -> list[list[int]]:
+    out = []
+    for part in s.split(";"):
+        try:
+            ids = [int(t) for t in part.replace(",", " ").split()]
+        except ValueError:
+            raise SystemExit(f"--prompt must be token ids, got {part!r}")
+        if not ids:
+            raise SystemExit("--prompt has an empty prompt "
+                             "(check for stray ';')")
+        out.append(ids)
+    return out
 
 
 def main(argv=None) -> int:
@@ -40,7 +50,12 @@ def main(argv=None) -> int:
     p.add_argument("--vocab_size", type=int, default=None)
     p.add_argument("--max_seq_len", type=int, default=None)
     p.add_argument("--prompt", required=True,
-                   help="comma/space-separated token ids")
+                   help="comma/space-separated token ids; several prompts "
+                        "separated by ';' decode as one left-padded batch")
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec for SHARDED generation (e.g. "
+                        "'data=2,tensor=4'); params restore into the "
+                        "training strategy's layout")
     p.add_argument("--max_new_tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy")
@@ -63,6 +78,8 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
+    import numpy as np
+
     from distributed_compute_pytorch_tpu.infer import generate
     from distributed_compute_pytorch_tpu.models.registry import build_model
     from distributed_compute_pytorch_tpu.train.checkpoint import (
@@ -74,11 +91,23 @@ def main(argv=None) -> int:
           if v is not None}
     model = build_model(args.model, **kw)
     template, _ = model.init(jax.random.key(0))
-    params = restore_params(args.ckpt_path, template)
+    mesh = None
+    if args.mesh is not None:
+        from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+        from distributed_compute_pytorch_tpu.parallel.api import (
+            pick_strategy, tree_shardings)
+        mesh = make_mesh(args.mesh)
+        # restore STRAIGHT into the mesh layout — no host-side full copy,
+        # which is what lets a bigger-than-one-chip checkpoint load at all
+        shardings = tree_shardings(pick_strategy(mesh, model),
+                                   template, mesh)
+        params = restore_params(args.ckpt_path, template, shardings)
+    else:
+        params = restore_params(args.ckpt_path, template)
 
-    ids = _parse_prompt(args.prompt)
+    prompts = _parse_prompts(args.prompt)
     vocab = model.config.vocab_size
-    bad = [t for t in ids if not 0 <= t < vocab]
+    bad = [t for ids in prompts for t in ids if not 0 <= t < vocab]
     if bad:
         # the embedding gather would CLAMP out-of-range ids silently
         raise SystemExit(f"prompt ids {bad} outside vocab [0, {vocab})")
@@ -90,17 +119,46 @@ def main(argv=None) -> int:
         # greedy ignores truncation; silence here would mislead
         raise SystemExit("--top_k/--top_p need --temperature > 0 "
                          "(sampling); temperature 0 is greedy")
-    prompt = jnp.asarray(ids, jnp.int32)[None, :]
+
+    # LEFT-padded batch (pads excluded from attention; each row decodes
+    # exactly as it would alone — pinned by tests/test_generate.py)
+    T0 = max(len(ids) for ids in prompts)
+    batch = np.zeros((len(prompts), T0), np.int32)
+    mask = np.zeros((len(prompts), T0), np.int32)
+    for i, ids in enumerate(prompts):
+        batch[i, T0 - len(ids):] = ids
+        mask[i, T0 - len(ids):] = 1
+    if mesh is not None:
+        # the batch axes need a divisible leading dim: pad with copies of
+        # the last row (dropped again before printing)
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            batch_sharding, dp_world_size)
+        ws = dp_world_size(mesh)
+        extra = (-len(prompts)) % ws
+        if extra:
+            batch = np.concatenate([batch] + [batch[-1:]] * extra)
+            mask = np.concatenate([mask] + [mask[-1:]] * extra)
+    prompt = jnp.asarray(batch)
+    prompt_mask = jnp.asarray(mask) if len(prompts) > 1 else None
+    if mesh is not None:
+        prompt = jax.device_put(prompt, batch_sharding(mesh, 2))
+        if prompt_mask is not None:
+            prompt_mask = jax.device_put(prompt_mask,
+                                         batch_sharding(mesh, 2))
+
     out = generate(model, params, prompt, args.max_new_tokens,
                    temperature=args.temperature, eos_id=args.eos_id,
                    top_k=args.top_k, top_p=args.top_p,
-                   rng=jax.random.key(args.seed))
-    toks = [int(t) for t in out[0]]
-    new = toks[len(ids):]
-    if args.eos_id is not None and args.eos_id in new:
-        new = new[:new.index(args.eos_id) + 1]
-    print(json.dumps({"prompt": ids, "tokens": toks[:len(ids)] + new,
-                      "new": new}))
+                   rng=jax.random.key(args.seed), prompt_mask=prompt_mask,
+                   mesh=mesh)
+    out = np.asarray(out)
+    for i, ids in enumerate(prompts):
+        toks = [int(t) for t in out[i, T0 - len(ids):]]
+        new = toks[len(ids):]
+        if args.eos_id is not None and args.eos_id in new:
+            new = new[:new.index(args.eos_id) + 1]
+        print(json.dumps({"prompt": ids, "tokens": toks[:len(ids)] + new,
+                          "new": new}))
     return 0
 
 

@@ -239,6 +239,50 @@ def constrain(x, spec: P):
         x, jax.sharding.NamedSharding(target, P(*cleaned)))
 
 
+def constrain_replicated(x):
+    """Pin ``x`` fully replicated when a mesh context is active (no-op
+    off-mesh and inside manual regions).
+
+    ``constrain`` can't express this — it drops all-``None`` specs as a
+    no-op — so the gather-output numerics guard (``layers.Embedding``)
+    gets its own entry point."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    am = jax.sharding.get_abstract_mesh()
+    manual = (set() if am is None or am.empty else
+              {n for n, t in zip(am.axis_names, am.axis_types)
+               if t == jax.sharding.AxisType.Manual})
+    if manual:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*([None] * x.ndim))))
+
+
+def constrain_activations(x, manual_axes=(), seq_axis: str = "seq"):
+    """Residual-stream layout pin: ``[B, T, d]`` batch-sharded over
+    ``(data, fsdp)``, everything else replicated — the canonical
+    activation layout between transformer blocks.
+
+    Two reasons this exists: (1) it is the layout the scaling-book recipe
+    wants (activations follow the batch; TP collectives stay inside the
+    block); (2) it is a NUMERICS guard — on 3-axis meshes (batch over
+    data x fsdp, params over fsdp x tensor) XLA's SPMD partitioner has
+    been observed to MISCOMPILE unannotated residual + TP-matmul chains
+    (wrong values on the mixed shards; repro'd pure-jax on jax 0.9.0 CPU
+    — see tests/test_generate.py's 3-axis mesh cases). Explicit
+    boundary pins keep the partitioner on the well-trodden path.
+
+    No-op inside manual regions (the pipeline owns layout there) and on
+    ring/seq meshes (the ring's shard_map owns the token dim)."""
+    if manual_axes:
+        return x
+    mesh = current_mesh()
+    if mesh is not None and dict(mesh.shape).get(seq_axis, 1) > 1:
+        return x
+    return constrain(x, P(("data", "fsdp"), None, None))
+
+
 def constrain_seq_parallel(x, manual_axes=(), seq_axis: str = "seq"):
     """Megatron sequence-parallel activation pin: residual stream
     ``[B, T, d]`` with the token dim sharded over ``tensor``. Shared by

@@ -31,12 +31,29 @@ and a left-padded batch must equal each prompt generated alone.
 
 from __future__ import annotations
 
-from functools import partial
+import contextlib
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_compute_pytorch_tpu.core.mesh import constrain, use_mesh
+
+# Decode-time mesh layout (engaged via ``constrain`` only when a mesh
+# context is active — a no-op otherwise): batch over the batch axes, KV
+# cache heads over ``tensor``. The cache is [B, Hk, t_max, hd]; sharding
+# Hk over tensor mirrors the Megatron column-parallel q/k/v training
+# layout, so the per-head attention compute and the cache's HBM traffic
+# split across the tensor group with no resharding against the params.
+_CACHE_SPEC = P(("data", "fsdp"), "tensor", None, None)
+
+
+def _constrain_cache(cache):
+    return {"k": constrain(cache["k"], _CACHE_SPEC),
+            "v": constrain(cache["v"], _CACHE_SPEC)}
 
 
 def _per_layer(stacked, i: int):
@@ -71,7 +88,8 @@ def prefill(model, params, prompt, t_max: int, prompt_mask=None):
         pad_count = T0 - jnp.sum(prompt_mask.astype(jnp.int32), axis=1)
         positions = jnp.maximum(jnp.arange(T0)[None, :]
                                 - pad_count[:, None], 0)
-    x = model.embed(params, prompt, positions)
+    x = constrain(model.embed(params, prompt, positions),
+                  P(("data", "fsdp"), None, None))
     dtype = x.dtype
     caches = []
     for i in range(_num_layers(params["blocks"])):
@@ -81,7 +99,7 @@ def prefill(model, params, prompt, t_max: int, prompt_mask=None):
         (k, v), = sink
         pad = lambda a: lax.dynamic_update_slice_in_dim(
             jnp.zeros((B, hk, t_max, hd), dtype), a.astype(dtype), 0, axis=2)
-        caches.append({"k": pad(k), "v": pad(v)})
+        caches.append(_constrain_cache({"k": pad(k), "v": pad(v)}))
     return model.readout(params, x)[:, -1], caches
 
 
@@ -111,7 +129,8 @@ def _sample(logits, temperature: float, rng, top_k: int | None = None,
 
 def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                      temperature: float = 0.0, eos_id: int | None = None,
-                     top_k: int | None = None, top_p: float | None = None):
+                     top_k: int | None = None, top_p: float | None = None,
+                     mesh=None):
     """Build a jitted ``(params, prompt [B, T0], rng) -> tokens
     [B, T0 + max_new_tokens]`` generation function.
 
@@ -120,9 +139,32 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
     ``eos_id``: rows that sample this token keep emitting it for the rest
     of the fixed-shape output (compiled loops cannot shrink; trim at the
     first eos).
+
+    ``mesh``: optional ``jax.sharding.Mesh`` — SHARDED generation. The
+    prompt/batch shards over the batch axes (``data``/``fsdp``), the KV
+    caches and attention heads over ``tensor`` (GQA: the *kv*-head dim is
+    what shards, so ``tensor`` must divide ``num_kv_heads``), and params
+    keep whatever layout the caller committed them to (restore a
+    checkpoint with ``parallel.api.shard_pytree`` under the training
+    strategy). This is how a model that needed FSDP/TP to train also
+    generates — nothing is gathered to one device.
     """
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if mesh is not None:
+        tp = dict(mesh.shape).get("tensor", 1)
+        hk, _ = model.kv_cache_spec()
+        if tp > 1 and hk % tp:
+            # GQA shards the NARROW cache: an indivisible kv-head dim would
+            # make XLA pad-and-replicate it, silently defeating the layout
+            raise ValueError(
+                f"tensor axis ({tp}) must divide num_kv_heads ({hk}) for "
+                f"sharded generation — the KV cache shards on kv heads")
+        if dict(mesh.shape).get("seq", 1) > 1:
+            # decode is one position per tick; there is no sequence to
+            # shard. Ring attention is a training/prefill concept.
+            raise ValueError("generation does not compose with a seq>1 "
+                             "mesh axis; fold those devices into data")
     vocab = getattr(model.config, "vocab_size", None)
     if top_k is not None and not 1 <= top_k <= (vocab or top_k):
         raise ValueError(f"top_k must be in [1, vocab={vocab}], got {top_k}")
@@ -145,6 +187,7 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
     def _generate(params, prompt, rng, _tmax, _masked, prompt_mask):
         if max_new_tokens == 0:        # static: prefill-only no-op
             return prompt
+        prompt = constrain(prompt, P(("data", "fsdp"), None))
         B, T0 = prompt.shape
         last_logits, caches = prefill(
             model, params, prompt, _tmax,
@@ -173,13 +216,14 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
             # would skew offsets by pad_count.
             positions = (jnp.atleast_1d(pos) if not _masked
                          else (pos - pad_count)[:, None])
-            x = model.embed(params, tok[:, None], positions)
+            x = constrain(model.embed(params, tok[:, None], positions),
+                          P(("data", "fsdp"), None, None))
             new_caches = []
             for li, c in enumerate(caches):
                 x, c2 = block.decode_step(
                     _per_layer(params["blocks"], li), x, c, pos,
                     slot_mask=slot_mask)
-                new_caches.append(c2)
+                new_caches.append(_constrain_cache(c2))
             logits = model.readout(params, x)[:, -1]
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits, temperature, sub, top_k, top_p)
@@ -235,24 +279,44 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                 raise ValueError("prompt_mask has fully-padded rows (or "
                                  "trailing pads); every row needs at "
                                  "least its final slot real")
-        return _generate(params, prompt, rng, tm,
-                         prompt_mask is not None, prompt_mask)
+        # trace-time mesh context: the constrain() pins inside _generate
+        # engage only when the mesh is current (same pattern as
+        # train.step.make_step_fns)
+        ctx = use_mesh(mesh) if mesh is not None else contextlib.nullcontext()
+        with ctx:
+            return _generate(params, prompt, rng, tm,
+                             prompt_mask is not None, prompt_mask)
 
     generate._jitted = _generate   # exposed for cache/retrace inspection
     return generate
 
 
+@lru_cache(maxsize=32)
+def _cached_generate_fn(model, max_new_tokens, t_max, temperature, eos_id,
+                        top_k, top_p, mesh):
+    """Memoized builder behind the one-shot :func:`generate` — repeated
+    one-shot calls with the same settings reuse one jit cache instead of
+    retracing each time (models are frozen dataclasses, so hashable;
+    ``Mesh`` is hashable too)."""
+    return make_generate_fn(model, max_new_tokens, t_max=t_max,
+                            temperature=temperature, eos_id=eos_id,
+                            top_k=top_k, top_p=top_p, mesh=mesh)
+
+
 def generate(model, params, prompt, max_new_tokens: int, *,
              t_max: int | None = None, temperature: float = 0.0, rng=None,
              prompt_mask=None, eos_id: int | None = None,
-             top_k: int | None = None, top_p: float | None = None):
+             top_k: int | None = None, top_p: float | None = None,
+             mesh=None):
     """One-shot convenience wrapper around :func:`make_generate_fn`.
 
     ``prompt_mask`` (``[B, T0]``, 1 = real) enables LEFT-padded
     variable-length prompt batches; ``eos_id`` stops rows at that token
-    (they pad the fixed-shape tail with it).
+    (they pad the fixed-shape tail with it). ``mesh`` enables sharded
+    generation (see :func:`make_generate_fn`). The underlying generation
+    function is memoized on all of these settings, so repeated one-shot
+    calls do not retrace.
     """
-    return make_generate_fn(model, max_new_tokens, t_max=t_max,
-                            temperature=temperature, eos_id=eos_id,
-                            top_k=top_k, top_p=top_p)(
+    return _cached_generate_fn(model, max_new_tokens, t_max, temperature,
+                               eos_id, top_k, top_p, mesh)(
         params, prompt, rng, prompt_mask=prompt_mask)

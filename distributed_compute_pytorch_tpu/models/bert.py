@@ -133,6 +133,9 @@ class BertMLM:
             x = scan_blocks(block_apply, params["blocks"], x, remat=c.remat,
                             rng=layers_rng, train=train,
                             unroll=c.unroll_layers)
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            constrain_activations)
+        x = constrain_activations(x)   # block-boundary layout discipline
         h = L.Dense(c.d_model, c.d_model).apply(params["mlm_dense"], x)
         h = jax.nn.gelu(h)
         h = L.LayerNorm(c.d_model).apply(params["mlm_ln"], h)

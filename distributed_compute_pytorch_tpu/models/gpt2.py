@@ -110,8 +110,16 @@ class GPT2:
                                                               positions))
 
     def readout(self, params, x):
-        """Final LayerNorm + weight-tied readout."""
+        """Final LayerNorm + weight-tied readout.
+
+        The entry pin completes the block-boundary layout discipline (see
+        ``core.mesh.constrain_activations``): without it the tied attend
+        against the (fsdp x tensor)-sharded table is the last place the
+        3-axis-mesh partitioner bug can strike."""
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            constrain_activations)
         c = self.config
+        x = constrain_activations(x)
         x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
         return L.Embedding(c.vocab_size, c.d_model).attend(params["wte"], x)
 

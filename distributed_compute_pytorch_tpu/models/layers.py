@@ -30,6 +30,7 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 
 def _uniform(key, shape, bound, dtype):
@@ -256,7 +257,26 @@ class Embedding:
             key, (self.vocab_size, self.features), self.param_dtype)}
 
     def apply(self, params, ids):
-        return params["embedding"][ids]
+        out = params["embedding"][ids]
+        # Pin the gather's output layout. Under 3-axis meshes (batch over
+        # data x fsdp, table over fsdp x tensor) XLA's SPMD partitioner
+        # MISCOMPILES an unannotated gather feeding a residual + TP-matmul
+        # chain — wrong values on the mixed (data, fsdp) shards, repro'd
+        # pure-jax on jax 0.9.0 CPU (see tests/test_generate.py mesh
+        # cases). An explicit constraint on the gather output sidesteps
+        # the bad partition choice; it is also simply the layout we want
+        # (activations batch-sharded, features replicated). No-op without
+        # a mesh context.
+        from distributed_compute_pytorch_tpu.core.mesh import constrain
+        if out.ndim == 3:
+            return constrain(out, P(("data", "fsdp"), None, None))
+        if out.ndim == 2:
+            # position-table lookups ([T, d]) and single-token embeds:
+            # leading dim is NOT batch; keep fully replicated
+            from distributed_compute_pytorch_tpu.core.mesh import (
+                constrain_replicated)
+            return constrain_replicated(out)
+        return out
 
     def attend(self, params, x):
         """Tied-softmax readout: ``x @ E^T``."""

@@ -130,13 +130,15 @@ class LlamaBlock:
         return x + dense(c.d_ff, c.d_model).apply(params["down"], gated)
 
     def _ssa(self, x, manual_axes):
-        """Megatron sequence-parallel activation pin for TP meshes (see
-        transformer.TransformerBlock.seq_shard_activations)."""
-        if not self.config.seq_shard_activations:
-            return x
+        """Residual-stream layout pin at the block boundaries: Megatron
+        sequence-parallel when opted in, the canonical batch-sharded
+        layout otherwise (doubles as the 3-axis-mesh numerics guard —
+        see ``core.mesh.constrain_activations``)."""
         from distributed_compute_pytorch_tpu.core.mesh import (
-            constrain_seq_parallel)
-        return constrain_seq_parallel(x, manual_axes)
+            constrain_activations, constrain_seq_parallel)
+        if self.config.seq_shard_activations:
+            return constrain_seq_parallel(x, manual_axes)
+        return constrain_activations(x, manual_axes)
 
     def apply(self, params, x, *, rng=None, train: bool = False,
               kv_mask=None, manual_axes=(), kv_sink=None):
@@ -220,8 +222,14 @@ class LlamaLM:
                                                           tokens)
 
     def readout(self, params, x):
-        """Final norm + untied LM head: ``[.., d]`` -> ``[.., vocab]``."""
+        """Final norm + untied LM head: ``[.., d]`` -> ``[.., vocab]``.
+
+        Entry pin: block-boundary layout discipline (see
+        ``core.mesh.constrain_activations``)."""
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            constrain_activations)
         c = self.config
+        x = constrain_activations(x)
         x = L.RMSNorm(c.d_model, c.rms_eps).apply(params["norm_f"], x)
         return L.Dense(c.d_model, c.vocab_size,
                        use_bias=False).apply(params["lm_head"], x)

@@ -302,6 +302,9 @@ class MoETransformerLM:
             (x, lb, z, dr), _ = jax.lax.scan(
                 body, (x, jnp.float32(0), jnp.float32(0), jnp.float32(0)),
                 (jnp.arange(L_n), params["blocks"]))
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            constrain_activations)
+        x = constrain_activations(x)   # block-boundary layout discipline
         x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
         logits = wte.attend(params["wte"], x)
         self_aux = {"lb_loss": lb / L_n, "z_loss": z / L_n,

@@ -154,12 +154,15 @@ class TransformerBlock:
         return L.dropout(h, self.dropout_rate, rng, train)
 
     def _ssa(self, x, manual_axes):
-        """Sequence-parallel activation pin (see the field docstring)."""
-        if not self.seq_shard_activations:
-            return x
+        """Residual-stream layout pin at the block boundaries: the
+        Megatron sequence-parallel layout when opted in, the canonical
+        batch-sharded layout otherwise (which doubles as the 3-axis-mesh
+        numerics guard — see ``core.mesh.constrain_activations``)."""
         from distributed_compute_pytorch_tpu.core.mesh import (
-            constrain_seq_parallel)
-        return constrain_seq_parallel(x, manual_axes, self.seq_axis)
+            constrain_activations, constrain_seq_parallel)
+        if self.seq_shard_activations:
+            return constrain_seq_parallel(x, manual_axes, self.seq_axis)
+        return constrain_activations(x, manual_axes, self.seq_axis)
 
     def apply(self, params, x, *, rng=None, train: bool = False,
               kv_mask=None, manual_axes=(), kv_sink=None):

@@ -30,14 +30,21 @@ def random_flip(x, rng):
 
 
 def random_crop(x, rng, pad: int = 4):
-    """Pad H/W by ``pad`` (zeros) and crop back at a per-example offset.
+    """Pad H/W by ``pad`` (edge-replicate) and crop back at a per-example
+    offset.
+
+    Edge mode, not zeros: this runs AFTER host-side normalization, where
+    a zero border is not background but an out-of-distribution
+    "blacker than black" value (ADVICE r3). Replicating the edge pixels
+    keeps the border in-distribution (torchvision's raw-pixel zero-pad
+    recipe pads BEFORE normalization, which we don't).
 
     The uniform offset in ``[0, 2*pad]`` makes the identity crop exactly
     as likely as any shift; output shape equals input shape, so one
     compilation serves the whole run.
     """
     B, H, W, C = x.shape
-    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="edge")
     ky, kx = jax.random.split(rng)
     oy = jax.random.randint(ky, (B,), 0, 2 * pad + 1)
     ox = jax.random.randint(kx, (B,), 0, 2 * pad + 1)

@@ -118,6 +118,30 @@ class ShardingRules:
         return self.fallback.spec_for(path, shape, mesh)
 
 
+def pick_strategy(mesh: Mesh, model, warn: Callable[[str], None] | None = None):
+    """Parameter-layout strategy implied by the mesh spec — the one-knob
+    parallelism rule shared by the trainer and the generation CLI:
+
+    - ``fsdp`` axis > 1         -> FSDP parameter sharding
+    - ``tensor``/``pipe``/``expert`` > 1 -> the model's ``partition_rules()``
+      (Megatron TP layout + stacked-layer dim over pipe), stacked on the
+      FSDP/DP fallback
+    """
+    axes = dict(mesh.shape)
+    fallback = FSDP() if axes.get("fsdp", 1) > 1 else DataParallel()
+    model_axes = {a: n for a in ("tensor", "pipe", "expert")
+                  if (n := axes.get(a, 1)) > 1}
+    if model_axes:
+        if hasattr(model, "partition_rules"):
+            return ShardingRules(rules=model.partition_rules(),
+                                 fallback=fallback)
+        if warn is not None:
+            warn(f"mesh has {model_axes} but model "
+                 f"{type(model).__name__} exposes no partition_rules(); "
+                 f"these axes will only replicate")
+    return fallback
+
+
 def tree_specs(strategy, params: PyTree, mesh: Mesh) -> PyTree:
     """PartitionSpec pytree matching ``params``' structure."""
     return jax.tree_util.tree_map_with_path(

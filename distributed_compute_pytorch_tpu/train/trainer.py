@@ -30,8 +30,6 @@ from distributed_compute_pytorch_tpu.data.loader import (
     DeviceFeeder, StreamingDeviceFeeder)
 from distributed_compute_pytorch_tpu.data.shards import ShardedFileDataset
 from distributed_compute_pytorch_tpu.models.registry import build_model
-from distributed_compute_pytorch_tpu.parallel.api import (
-    DataParallel, FSDP, ShardingRules)
 from distributed_compute_pytorch_tpu.train import checkpoint
 from distributed_compute_pytorch_tpu.train.elastic import (
     Heartbeat, Preempted, PreemptionGuard, restart_count)
@@ -196,19 +194,13 @@ class Trainer:
         - ``tensor``/``pipe`` > 1   -> the model's ``partition_rules()``
           (Megatron TP layout + stacked-layer dim over pipe), stacked on
           the FSDP/DP fallback
+
+        Shared with ``dcp-generate`` via ``parallel.api.pick_strategy`` so
+        a checkpoint restores under the same layout it trained with.
         """
-        axes = dict(self.mesh.shape)
-        fallback = FSDP() if axes.get("fsdp", 1) > 1 else DataParallel()
-        model_axes = {a: n for a in ("tensor", "pipe", "expert")
-                      if (n := axes.get(a, 1)) > 1}
-        if model_axes:
-            if hasattr(self.model, "partition_rules"):
-                return ShardingRules(rules=self.model.partition_rules(),
-                                     fallback=fallback)
-            log0(f"WARNING: mesh has {model_axes} but model "
-                 f"{self.config.model!r} exposes no partition_rules(); "
-                 f"these axes will only replicate")
-        return fallback
+        from distributed_compute_pytorch_tpu.parallel.api import pick_strategy
+        return pick_strategy(self.mesh, self.model,
+                             warn=lambda m: log0(f"WARNING: {m}"))
 
     def _model_kwargs(self) -> dict:
         """Dataset-derived model construction kwargs, so every (model,

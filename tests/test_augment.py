@@ -36,7 +36,10 @@ def test_random_crop_is_a_shift_window():
                     .astype(np.float32))
     y = random_crop(x, jax.random.key(2), pad=2)
     assert y.shape == x.shape
-    xp = np.pad(np.asarray(x), ((0, 0), (2, 2), (2, 2), (0, 0)))
+    # edge-replicate padding: post-normalization zeros would be an
+    # out-of-distribution border (see ops/augment.py)
+    xp = np.pad(np.asarray(x), ((0, 0), (2, 2), (2, 2), (0, 0)),
+                mode="edge")
     # each output must appear verbatim as SOME window of its padded input
     for i in range(32):
         found = any(

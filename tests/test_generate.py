@@ -288,3 +288,215 @@ def test_generate_is_one_compiled_program():
     gen(params, p2)
     assert gen._jitted._cache_size() == 1, gen._jitted._cache_size()
 
+
+
+# ---------------------------------------------------------------------------
+# Sharded (mesh-aware) generation — VERDICT r3 #1: the framework's "every
+# strategy composes" claim must survive inference. A model that trained
+# FSDP/TP-sharded generates under the SAME layout, nothing gathered to one
+# device.
+# ---------------------------------------------------------------------------
+
+
+def _sharded(model, params, mesh):
+    from distributed_compute_pytorch_tpu.parallel.api import (
+        pick_strategy, shard_pytree)
+    return shard_pytree(params, pick_strategy(mesh, model), mesh)
+
+
+@pytest.mark.parametrize("name,model", _models())
+@pytest.mark.parametrize("spec", ["data=4,tensor=2", "fsdp=8",
+                                  "data=2,fsdp=2,tensor=2"])
+def test_mesh_generate_matches_full_forward(name, model, spec, devices8):
+    """The gold parity test, SHARDED: cached generation under a mesh ==
+    greedily decoding with a full forward per step under the SAME mesh,
+    token for token — cache indexing/rope/GQA grouping survive TP
+    (kv-head-sharded cache), FSDP (sharded params) and DP batch sharding.
+    (Cross-LAYOUT equality is a logits-tolerance property — collective
+    reduction order shifts argmax at random-init near-ties — and is
+    checked separately in test_mesh_prefill_logits_close_to_unsharded.)"""
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        batch_sharding, make_mesh, use_mesh)
+
+    params, _ = model.init(jax.random.key(0))
+    B, T0, N = 8, 8, 8
+    prompt = jax.device_put(
+        jax.random.randint(jax.random.key(1), (B, T0), 0, 256, jnp.int32),
+        batch_sharding(make_mesh(spec, devices=devices8), 2))
+
+    mesh = make_mesh(spec, devices=devices8)
+    sharded = _sharded(model, params, mesh)
+    out = make_generate_fn(model, N, mesh=mesh)(sharded, prompt)
+
+    # reference: full forward per step under the same mesh/layout
+    toks = prompt
+    fwd = jax.jit(lambda p, t: model.apply(p, {}, t, train=False)[0])
+    for _ in range(N):
+        with use_mesh(mesh):
+            logits = fwd(sharded, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(toks.dtype)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+@pytest.mark.parametrize("name,model", _models())
+def test_mesh_prefill_logits_close_to_unsharded(name, model, devices8):
+    """Cross-layout agreement: sharded prefill logits == unsharded
+    full-forward logits to float32 tolerance (bitwise equality is not a
+    property of resharded collectives; tolerance matches the TP==DP
+    ladder tests)."""
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        batch_sharding, make_mesh, use_mesh)
+
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (8, 8), 0, 256,
+                                jnp.int32)
+    ref, _ = model.apply(params, {}, prompt, train=False)
+
+    mesh = make_mesh("data=2,fsdp=2,tensor=2", devices=devices8)
+    sharded = _sharded(model, params, mesh)
+    with use_mesh(mesh):
+        last, _ = jax.jit(lambda p, t: prefill(model, p, t, 16))(
+            sharded, jax.device_put(prompt, batch_sharding(mesh, 2)))
+    np.testing.assert_allclose(np.asarray(last), np.asarray(ref[:, -1]),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("name,model", _models())
+def test_mesh_generate_left_padded(name, model, devices8):
+    """Variable-length left-padded batches work under a TP x DP mesh."""
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        batch_sharding, make_mesh)
+
+    params, _ = model.init(jax.random.key(0))
+    B, T0, N = 4, 8, 6
+    prompt = jax.random.randint(jax.random.key(2), (B, T0), 1, 256,
+                                jnp.int32)
+    lens = np.array([8, 5, 3, 7])
+    mask = (np.arange(T0)[None, :] >= (T0 - lens)[:, None]).astype(np.int32)
+    mask_j = jnp.asarray(mask)
+
+    ref = generate(model, params, prompt, N, prompt_mask=mask_j)
+    mesh = make_mesh("data=4,tensor=2", devices=devices8)
+    gen = make_generate_fn(model, N, mesh=mesh)
+    out = gen(_sharded(model, params, mesh),
+              jax.device_put(prompt, batch_sharding(mesh, 2)),
+              prompt_mask=mask_j)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_mesh_generate_cache_actually_sharded(devices8):
+    """The KV cache must actually land sharded: batch over data, kv heads
+    over tensor — not silently replicated (which would defeat the point
+    for a model that needed sharding to fit)."""
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        batch_sharding, make_mesh, use_mesh)
+
+    model = LlamaLM(LlamaConfig.tiny())     # GQA: 2 kv heads
+    params, _ = model.init(jax.random.key(0))
+    mesh = make_mesh("data=4,tensor=2", devices=devices8)
+    prompt = jax.device_put(
+        jax.random.randint(jax.random.key(1), (8, 8), 0, 256, jnp.int32),
+        batch_sharding(mesh, 2))
+    sharded = _sharded(model, params, mesh)
+    with use_mesh(mesh):
+        _, caches = jax.jit(
+            lambda p, t: prefill(model, p, t, 16))(sharded, prompt)
+    k = caches[0]["k"]
+    spec = k.sharding.spec
+    assert spec[0] in ("data", ("data",), ("data", "fsdp")), spec
+    assert spec[1] == "tensor", spec
+    # 8-way batch over 4 data shards x 2 kv heads over 2 tensor shards
+    # (tiny llama: head_dim = 64/4 = 16)
+    assert k.addressable_shards[0].data.shape == (2, 1, 16, 16), (
+        k.addressable_shards[0].data.shape)
+
+
+def test_mesh_generate_rejects_indivisible_tensor(devices8):
+    """tensor axis must divide num_kv_heads (the cache shards on kv
+    heads); a silent pad-and-replicate would defeat the layout."""
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+
+    model = LlamaLM(LlamaConfig.tiny())     # 2 kv heads
+    mesh = make_mesh("data=1,tensor=8", devices=devices8)
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        make_generate_fn(model, 4, mesh=mesh)
+
+
+def test_mesh_generate_sampling_deterministic(devices8):
+    """Sampling under a mesh is deterministic per key (the rng stream is
+    replicated; sharding must not fork it)."""
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        batch_sharding, make_mesh)
+
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    mesh = make_mesh("data=4,tensor=2", devices=devices8)
+    prompt = jax.device_put(
+        jax.random.randint(jax.random.key(3), (4, 6), 0, 256, jnp.int32),
+        batch_sharding(mesh, 2))
+    gen = make_generate_fn(model, 6, temperature=0.8, mesh=mesh)
+    sharded = _sharded(model, params, mesh)
+    a = gen(sharded, prompt, jax.random.key(7))
+    b = gen(sharded, prompt, jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cli_generate_mesh_and_multiprompt(tmp_path, capsys, devices8):
+    """dcp-generate --mesh restores into the mesh layout and decodes a
+    ';'-separated left-padded multi-prompt batch, one JSON line each —
+    rows match generating each prompt alone (unsharded)."""
+    import json
+
+    from distributed_compute_pytorch_tpu.cli_generate import main as gen_main
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    ck = str(tmp_path / "ck.npz")
+    data = synthetic_lm(64, seq_len=16, vocab=256, seed=9)
+    cfg = Config(batch_size=32, lr=1e-3, epochs=1, mesh="data=8",
+                 model="llama", model_preset="tiny",
+                 dataset="synthetic-lm", optimizer="adamw", ckpt_path=ck)
+    Trainer(cfg, train_data=data, eval_data=data).fit()
+
+    rc = gen_main(["--ckpt_path", ck, "--model", "llama",
+                   "--model_preset", "tiny", "--max_seq_len", "16",
+                   "--mesh", "data=4,tensor=2",
+                   "--prompt", "5, 9, 12; 7; 1 2 3 4",
+                   "--max_new_tokens", "4"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()[-3:]]
+    assert [l["prompt"] for l in lines] == [[5, 9, 12], [7], [1, 2, 3, 4]]
+    for l in lines:
+        assert len(l["new"]) == 4
+        assert l["tokens"] == l["prompt"] + l["new"]
+
+    # each row == that prompt generated alone, unsharded (trained params:
+    # logits are well-separated, so argmax is stable across layouts)
+    for l in lines:
+        capsys.readouterr()
+        rc = gen_main(["--ckpt_path", ck, "--model", "llama",
+                       "--model_preset", "tiny", "--max_seq_len", "16",
+                       "--prompt", ",".join(map(str, l["prompt"])),
+                       "--max_new_tokens", "4"])
+        assert rc == 0
+        solo = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert solo["new"] == l["new"], (solo, l)
+
+
+def test_one_shot_generate_memoized():
+    """Repeated one-shot generate() calls with identical settings reuse
+    one underlying jitted function instead of retracing (ADVICE r3)."""
+    from distributed_compute_pytorch_tpu.infer import _cached_generate_fn
+
+    model = GPT2(GPT2Config.tiny())
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 0, 256)
+    _cached_generate_fn.cache_clear()
+    a = generate(model, params, prompt, 4)
+    b = generate(model, params, prompt, 4)
+    info = _cached_generate_fn.cache_info()
+    assert info.hits >= 1 and info.misses == 1, info
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
