@@ -49,9 +49,16 @@ def main(argv=None) -> int:
     p.add_argument("--model_preset", default=None)
     p.add_argument("--vocab_size", type=int, default=None)
     p.add_argument("--max_seq_len", type=int, default=None)
-    p.add_argument("--prompt", required=True,
+    p.add_argument("--prompt", default=None,
                    help="comma/space-separated token ids; several prompts "
                         "separated by ';' decode as one left-padded batch")
+    p.add_argument("--text_prompt", action="append", default=None,
+                   help="TEXT prompt, encoded with --tokenizer and decoded "
+                        "back to text (repeat the flag for a batch); "
+                        "mutually exclusive with --prompt")
+    p.add_argument("--tokenizer", default="byte",
+                   help="'byte' or a tokenizer .json — must match the one "
+                        "the corpus was tokenized with (--dataset text)")
     p.add_argument("--mesh", default=None,
                    help="mesh spec for SHARDED generation (e.g. "
                         "'data=2,tensor=4'); params restore into the "
@@ -90,7 +97,11 @@ def main(argv=None) -> int:
                             ("max_seq_len", args.max_seq_len))
           if v is not None}
     model = build_model(args.model, **kw)
-    template, _ = model.init(jax.random.key(0))
+    # ABSTRACT template: structure/shapes/dtypes only — a concrete init
+    # would materialise the full unsharded model on one device, defeating
+    # the sharded-restore path for bigger-than-one-chip checkpoints
+    template = jax.eval_shape(lambda k: model.init(k)[0],
+                              jax.random.key(0))
     mesh = None
     if args.mesh is not None:
         from distributed_compute_pytorch_tpu.core.mesh import make_mesh
@@ -105,7 +116,33 @@ def main(argv=None) -> int:
     else:
         params = restore_params(args.ckpt_path, template)
 
-    prompts = _parse_prompts(args.prompt)
+    tok = None
+    if args.text_prompt is not None:
+        if args.prompt is not None:
+            raise SystemExit("--prompt and --text_prompt are mutually "
+                             "exclusive")
+        from distributed_compute_pytorch_tpu.data.tokenizer import (
+            build_tokenizer)
+        tok = build_tokenizer(args.tokenizer)
+        if tok.vocab_size != model.config.vocab_size:
+            # the trainer sizes the model vocab EXACTLY to the tokenizer
+            # (--dataset text); any mismatch means this is not the
+            # training tokenizer and the ids would silently mean
+            # different tokens (e.g. forgetting --tokenizer falls back
+            # to 'byte', vocab 259)
+            raise SystemExit(
+                f"tokenizer vocab ({tok.vocab_size}) != model vocab "
+                f"({model.config.vocab_size}) — pass the --tokenizer "
+                f"the model was trained with")
+        prompts = [tok.encode(t) for t in args.text_prompt]
+        if any(not p for p in prompts):
+            raise SystemExit("--text_prompt encodes to zero tokens")
+        if args.eos_id is None:
+            args.eos_id = tok.eos_id   # text mode: stop at the text eos
+    elif args.prompt is not None:
+        prompts = _parse_prompts(args.prompt)
+    else:
+        raise SystemExit("one of --prompt / --text_prompt is required")
     vocab = model.config.vocab_size
     bad = [t for ids in prompts for t in ids if not 0 <= t < vocab]
     if bad:
@@ -157,8 +194,10 @@ def main(argv=None) -> int:
         new = toks[len(ids):]
         if args.eos_id is not None and args.eos_id in new:
             new = new[:new.index(args.eos_id) + 1]
-        print(json.dumps({"prompt": ids, "tokens": toks[:len(ids)] + new,
-                          "new": new}))
+        rec = {"prompt": ids, "tokens": toks[:len(ids)] + new, "new": new}
+        if tok is not None:
+            rec["text"] = args.text_prompt[i] + tok.decode(new)
+        print(json.dumps(rec))
     return 0
 
 

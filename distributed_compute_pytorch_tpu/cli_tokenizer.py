@@ -1,0 +1,55 @@
+"""``dcp-tokenizer`` — train a byte-level BPE tokenizer on a text corpus.
+
+Companion of ``--dataset text``: train once, then pass the saved .json to
+``dcp-train --tokenizer`` and ``dcp-generate --tokenizer`` so the corpus
+windows and the generation prompts agree on ids.
+
+    dcp-tokenizer --corpus corpus.txt --vocab_size 512 --out tok.json
+
+Prints one JSON line: {"vocab_size": N, "merges": M, "out": path,
+"compression": tokens_per_byte}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--corpus", required=True,
+                   help="UTF-8 .txt file (or directory of them)")
+    p.add_argument("--vocab_size", type=int, default=512,
+                   help=">= 259 (256 bytes + pad/bos/eos); the merge "
+                        "budget is vocab_size - 259")
+    p.add_argument("--out", required=True, help="output tokenizer .json")
+    p.add_argument("--max_sample_bytes", type=int, default=1 << 20,
+                   help="cap on corpus bytes used for pair counting")
+    args = p.parse_args(argv)
+
+    from distributed_compute_pytorch_tpu.data.tokenizer import (
+        BPETokenizer, read_text_docs)
+
+    text = "".join(read_text_docs(args.corpus))
+
+    tok = BPETokenizer.train(text, args.vocab_size,
+                             max_sample_bytes=args.max_sample_bytes)
+    tok.save(args.out)
+    n_bytes = len(text.encode("utf-8"))
+    n_tokens = len(tok.encode(text[:100_000]))  # compression on a sample
+    sample_bytes = len(text[:100_000].encode("utf-8"))
+    print(json.dumps({
+        "vocab_size": tok.vocab_size,
+        "merges": len(tok.merges),
+        "out": args.out,
+        "corpus_bytes": n_bytes,
+        "compression": round(n_tokens / max(sample_bytes, 1), 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

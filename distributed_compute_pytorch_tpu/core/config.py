@@ -49,6 +49,8 @@ class Config:
     model: str = "convnet"         # convnet | resnet18 | resnet50 | bert | gpt2 | moe | llama
     model_preset: str | None = None  # e.g. 'tiny' for test-scale transformers
     microbatches: int | None = None  # GPipe microbatches under a pipe axis
+    virtual_stages: int = 1        # Megatron interleaved pipeline: v layer
+                                   # chunks per device (needs M <= pipe)
     dataset: str = "mnist"         # mnist | cifar10 | synthetic-images | synthetic-lm
     optimizer: str = "adadelta"    # adadelta (reference stack) | sgd | adamw
                                    # | adamw_fused (Pallas single-pass kernel)
@@ -59,6 +61,11 @@ class Config:
 
     # --- data / checkpoint paths ---
     data_dir: str = "./data"       # reference uses './data/' (main.py:107)
+    # --- real-text LM corpus (--dataset text: data_dir is a .txt file) ---
+    seq_len: int = 256             # training-window length for text corpora
+    tokenizer: str = "byte"        # 'byte' or path to a tokenizer .json
+                                   # (data/tokenizer.py; train a BPE with
+                                   # dcp-tokenizer)
     prefetch: int = 2              # feeder prefetch depth (0 = synchronous);
                                    # the DataLoader-workers role (main.py:110)
     require_real_data: bool = False  # error (not warn) if real data missing
@@ -158,12 +165,19 @@ class Config:
         p.add_argument("--microbatches", type=int, default=None,
                        help="GPipe microbatch count under a pipe mesh axis "
                             "(default: pipe size)")
+        p.add_argument("--virtual_stages", type=int, default=cls.virtual_stages,
+                       help="Megatron interleaved pipeline: v layer chunks "
+                            "per device (needs microbatches <= pipe)")
         p.add_argument("--dataset", type=str, default=cls.dataset)
         p.add_argument("--optimizer", type=str, default=cls.optimizer,
                        help="adadelta (reference stack) | sgd | adamw")
         p.add_argument("--log_every", type=int, default=cls.log_every)
         p.add_argument("--seed", type=int, default=cls.seed)
         p.add_argument("--data_dir", type=str, default=cls.data_dir)
+        p.add_argument("--seq_len", type=int, default=cls.seq_len,
+                       help="window length for --dataset text")
+        p.add_argument("--tokenizer", type=str, default=cls.tokenizer,
+                       help="'byte' or a tokenizer .json (dcp-tokenizer)")
         p.add_argument("--prefetch", type=int, default=cls.prefetch,
                        help="feeder prefetch depth (0 = synchronous)")
         p.add_argument("--require_real_data", action="store_true",

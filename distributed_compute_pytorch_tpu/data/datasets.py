@@ -49,6 +49,9 @@ class ArrayDataset:
     inputs: np.ndarray
     targets: np.ndarray
     name: str = "dataset"
+    # explicit class/vocab count for datasets whose targets need not cover
+    # the full range (e.g. a tokenized corpus never emitting some ids)
+    num_classes_override: int | None = None
 
     def __post_init__(self):
         assert len(self.inputs) == len(self.targets)
@@ -58,6 +61,8 @@ class ArrayDataset:
 
     @property
     def num_classes(self) -> int:
+        if self.num_classes_override is not None:
+            return self.num_classes_override
         return int(self.targets.max()) + 1
 
 
@@ -318,6 +323,79 @@ def synthetic_lm(n: int, seq_len: int, vocab: int, seed: int = 0,
     return ArrayDataset(toks, toks, name=name)
 
 
+def text_lm(path: str, seq_len: int = 256, tokenizer: str = "byte",
+            split: str = "train", eval_fraction: float = 0.05,
+            add_eos: bool = True) -> ArrayDataset:
+    """Tokenize a UTF-8 text file into fixed-length LM training sequences.
+
+    The real-data path for the LM rungs (the reference's data layer pulls
+    real MNIST, ``main.py:107``; this is the text equivalent). The token
+    stream is chunked into ``[N, seq_len]`` windows; the LAST
+    ``eval_fraction`` of windows form the test split (a contiguous tail —
+    random splits of a sliding-window corpus leak n-gram overlap between
+    train and eval). ``num_classes`` reports the tokenizer's full vocab
+    (not the max id seen), so model sizing is independent of which bytes
+    the corpus happens to contain.
+    """
+    from distributed_compute_pytorch_tpu.data.tokenizer import (
+        BPETokenizer, build_tokenizer, read_text_docs)
+    tok = build_tokenizer(tokenizer)
+    docs = read_text_docs(path)
+
+    def _encode_all() -> np.ndarray:
+        ids: list[int] = []
+        for doc in docs:
+            ids.extend(tok.encode(doc))
+            if add_eos:
+                ids.append(tok.eos_id)
+        return np.asarray(ids, np.int32)
+
+    if isinstance(tok, BPETokenizer) and tok.merges:
+        # BPE encode is O(merges x corpus) pure python — cache the token
+        # stream in a sidecar keyed by (corpus bytes, merge table), so a
+        # big corpus tokenizes once, not on every trainer start (and not
+        # twice for the train/test splits)
+        import hashlib
+        h = hashlib.sha1()
+        for doc in docs:
+            b = doc.encode("utf-8")
+            # length prefix: doc BOUNDARIES are part of the token stream
+            # (eos separators, merges not crossing docs) — re-splitting
+            # the same bytes into different docs must miss the cache
+            h.update(f"{len(b)}:".encode())
+            h.update(b)
+        h.update(repr(tok.merges).encode())
+        h.update(str(add_eos).encode())
+        side_dir = path if os.path.isdir(path) else os.path.dirname(
+            os.path.abspath(path))
+        sidecar = os.path.join(
+            side_dir, f".tokcache-{h.hexdigest()[:16]}.npy")
+        if os.path.exists(sidecar):
+            ids_arr = np.load(sidecar)
+        else:
+            ids_arr = _encode_all()
+            try:
+                from distributed_compute_pytorch_tpu.utils.fsio import (
+                    atomic_write)
+                atomic_write(sidecar, lambda f: np.save(f, ids_arr))
+            except OSError:
+                pass    # read-only corpus dir: just skip the cache
+    else:
+        ids_arr = _encode_all()
+    ids = ids_arr
+    n_seq = len(ids) // seq_len
+    if n_seq < 2:
+        raise ValueError(
+            f"corpus {path!r} tokenizes to {len(ids)} tokens — too short "
+            f"for even two seq_len={seq_len} windows")
+    toks = np.asarray(ids[:n_seq * seq_len], np.int32).reshape(n_seq,
+                                                               seq_len)
+    n_eval = max(1, int(round(n_seq * eval_fraction)))
+    sel = toks[-n_eval:] if split == "test" else toks[:n_seq - n_eval]
+    return ArrayDataset(sel, sel, name=f"text:{os.path.basename(path)}",
+                        num_classes_override=tok.vocab_size)
+
+
 def load_dataset(name: str, data_dir: str = "./data", split: str = "train",
                  synthetic_fallback: bool = True, **kw) -> ArrayDataset:
     """Registry entry point used by the trainer CLI.
@@ -340,6 +418,11 @@ def load_dataset(name: str, data_dir: str = "./data", split: str = "train",
         return synthetic_lm(kw.pop("n", 2048), kw.pop("seq_len", 128),
                             kw.pop("vocab", 256),
                             seed=0 if split == "train" else 1)
+    if name == "text":
+        # real-text LM corpus: ``data_dir`` is a UTF-8 .txt file (or a
+        # directory of them)
+        return text_lm(data_dir, seq_len=kw.pop("seq_len", 256),
+                       tokenizer=kw.pop("tokenizer", "byte"), split=split)
     if name == "sharded":
         # out-of-core streaming dataset (data/shards.py): ``data_dir`` is a
         # shard directory, or a parent holding train/ and test/ shard dirs

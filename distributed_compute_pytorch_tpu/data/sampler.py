@@ -79,6 +79,10 @@ class ShardedSampler:
             pad = self.padded_size - self.num_examples
             if pad:
                 # wraparound padding — same rule as DistributedSampler's
-                # `indices += indices[:padding_size]`
-                order = np.concatenate([order, order[:pad]])
+                # `indices += indices[:padding_size]`, except cycling the
+                # order as many times as needed: a dataset SMALLER than one
+                # global batch (pad > num_examples, e.g. a tiny text-corpus
+                # eval split) must still fill the batch
+                reps = -(-pad // len(order))
+                order = np.concatenate([order, np.tile(order, reps)[:pad]])
         return order.reshape(self.num_batches, self.global_batch)

@@ -1,0 +1,203 @@
+"""Tokenizers for the real-text LM pipeline.
+
+The reference repo has no text path at all (its data layer decodes MNIST
+images, ``/root/reference/main.py:107-116``); the framework's LM rungs
+need one (VERDICT r3 #4). Two tokenizers, one contract:
+
+- **ByteTokenizer** — the zero-configuration baseline: ids 0..255 are the
+  raw UTF-8 bytes, plus ``<pad>``/``<bos>``/``<eos>`` specials. Trivially
+  reversible, no training, vocab 259. Perfect for tests and small
+  corpora; ~1 token/byte.
+- **BPETokenizer** — byte-level BPE (the GPT-2 recipe minus the regex
+  pre-splitting): starts from bytes, greedily merges the most frequent
+  adjacent pair until ``vocab_size``; encode applies merges lowest-rank
+  first. Trains in pure numpy/python (corpora here are test-scale; cap
+  with ``max_sample_bytes``), round-trips exactly, and serialises to a
+  single JSON file.
+
+Shared contract: ``encode(str) -> list[int]``, ``decode(ids) -> str``
+(specials dropped, invalid UTF-8 replaced), ``vocab_size``, ``pad_id``,
+``bos_id``, ``eos_id``. ``build_tokenizer(spec)`` maps the CLI string:
+``"byte"`` or a path to a trained BPE JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+_SPECIALS = ("<pad>", "<bos>", "<eos>")
+
+
+class _TokenizerBase:
+    """Byte-level encode/decode shared by both tokenizers; subclasses set
+    ``_n_base`` (ids below it decode through the byte table)."""
+
+    @property
+    def pad_id(self) -> int:
+        return self.vocab_size - 3
+
+    @property
+    def bos_id(self) -> int:
+        return self.vocab_size - 2
+
+    @property
+    def eos_id(self) -> int:
+        return self.vocab_size - 1
+
+    def decode(self, ids) -> str:
+        data = bytearray()
+        for t in ids:
+            t = int(t)
+            if t >= self.vocab_size - 3:      # specials carry no bytes
+                continue
+            data.extend(self._bytes_of(t))
+        return data.decode("utf-8", errors="replace")
+
+
+@dataclass(frozen=True)
+class ByteTokenizer(_TokenizerBase):
+    """ids 0..255 = UTF-8 bytes; 256/257/258 = pad/bos/eos."""
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(_SPECIALS)
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def _bytes_of(self, t: int) -> bytes:
+        return bytes([t])
+
+    def save(self, path: str) -> None:
+        from distributed_compute_pytorch_tpu.utils.fsio import atomic_write
+        atomic_write(path,
+                     lambda f: f.write(json.dumps({"kind": "byte"}).encode()))
+
+
+@dataclass(frozen=True)
+class BPETokenizer(_TokenizerBase):
+    """Byte-level BPE: ids 0..255 = bytes, then one id per learned merge,
+    then the three specials."""
+
+    merges: tuple[tuple[int, int], ...]   # rank-ordered (a, b) pairs
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges) + len(_SPECIALS)
+
+    # -- train ---------------------------------------------------------
+
+    @classmethod
+    def train(cls, text: str, vocab_size: int,
+              max_sample_bytes: int = 1 << 20) -> "BPETokenizer":
+        """Greedy most-frequent-pair merging over the corpus bytes.
+
+        ``vocab_size`` includes the 256 bytes and 3 specials, so the merge
+        count is ``vocab_size - 259``; a corpus too small to support that
+        many merges just stops early (every remaining pair unique).
+        """
+        n_merges = vocab_size - 256 - len(_SPECIALS)
+        if n_merges < 0:
+            raise ValueError(f"vocab_size must be >= 259, got {vocab_size}")
+        seq = list(text.encode("utf-8")[:max_sample_bytes])
+        merges: list[tuple[int, int]] = []
+        for new_id in range(256, 256 + n_merges):
+            counts: dict[tuple[int, int], int] = {}
+            for a, b in zip(seq, seq[1:]):
+                counts[(a, b)] = counts.get((a, b), 0) + 1
+            if not counts:
+                break
+            pair, freq = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+            if freq < 2:        # nothing left worth merging
+                break
+            merges.append(pair)
+            seq = cls._apply_merge(seq, pair, new_id)
+        return cls(merges=tuple(merges))
+
+    @staticmethod
+    def _apply_merge(seq: list[int], pair: tuple[int, int],
+                     new_id: int) -> list[int]:
+        out, i, n = [], 0, len(seq)
+        a, b = pair
+        while i < n:
+            if i + 1 < n and seq[i] == a and seq[i + 1] == b:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(seq[i])
+                i += 1
+        return out
+
+    # -- encode / decode ----------------------------------------------
+
+    def encode(self, text: str) -> list[int]:
+        seq = list(text.encode("utf-8"))
+        for rank, pair in enumerate(self.merges):
+            seq = self._apply_merge(seq, pair, 256 + rank)
+        return seq
+
+    def _bytes_of(self, t: int) -> bytes:
+        if t < 256:
+            return bytes([t])
+        a, b = self.merges[t - 256]
+        return self._bytes_of(a) + self._bytes_of(b)
+
+    # -- persistence ---------------------------------------------------
+
+    def save(self, path: str) -> None:
+        from distributed_compute_pytorch_tpu.utils.fsio import atomic_write
+        payload = json.dumps(
+            {"kind": "bpe",
+             "merges": [list(m) for m in self.merges]}).encode()
+        atomic_write(path, lambda f: f.write(payload))
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            d = json.load(f)
+        if d.get("kind") != "bpe":
+            raise ValueError(f"{path!r} is not a BPE tokenizer file "
+                             f"(kind={d.get('kind')!r})")
+        return cls(merges=tuple((int(a), int(b)) for a, b in d["merges"]))
+
+
+def read_text_docs(path: str) -> list[str]:
+    """Read a corpus as a list of documents: a single UTF-8 ``.txt`` file
+    is one document; a directory contributes its ``.txt`` files in sorted
+    order. One reader shared by ``datasets.text_lm`` and
+    ``dcp-tokenizer`` so both see the same byte stream (eos separators
+    are token-level and out of the byte alphabet, so they don't perturb
+    BPE pair statistics)."""
+    if os.path.isdir(path):
+        docs = []
+        for fn in sorted(os.listdir(path)):
+            if fn.endswith(".txt"):
+                with open(os.path.join(path, fn), encoding="utf-8") as f:
+                    docs.append(f.read())
+        if not docs:
+            raise FileNotFoundError(f"no .txt files under {path!r}")
+        return docs
+    with open(path, encoding="utf-8") as f:
+        return [f.read()]
+
+
+def build_tokenizer(spec: str):
+    """CLI entry: ``"byte"`` -> ByteTokenizer; a ``.json`` path -> the
+    tokenizer saved there (byte or trained BPE)."""
+    if spec in (None, "", "byte"):
+        return ByteTokenizer()
+    if os.path.exists(spec):
+        with open(spec) as f:
+            d = json.load(f)
+        kind = d.get("kind")
+        if kind == "byte":
+            return ByteTokenizer()
+        if kind == "bpe":
+            return BPETokenizer(
+                merges=tuple((int(a), int(b)) for a, b in d["merges"]))
+        raise ValueError(f"{spec!r} is not a tokenizer file "
+                         f"(kind={kind!r})")
+    raise ValueError(f"unknown tokenizer {spec!r}: expected 'byte' or a "
+                     f"path to a tokenizer .json")

@@ -45,6 +45,8 @@ class BertConfig:
     pad_token_id: int | None = None
     # GPipe microbatch count under a pipe axis (None = pipe size)
     pipeline_microbatches: int | None = None
+    # Megatron interleaved schedule (parallel/pipeline.py)
+    virtual_stages: int = 1
     remat: bool | str = False      # rematerialise blocks on backward
                                    # (True/"block"; "stage" under pipe)
     unroll_layers: bool = True     # python-loop blocks (see GPT2Config)
@@ -125,7 +127,8 @@ class BertMLM:
             x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
                                 num_microbatches=c.pipeline_microbatches,
                                 rng=layers_rng, train=train, remat=c.remat,
-                                kv_mask=kv_mask)
+                                kv_mask=kv_mask,
+                                virtual_stages=c.virtual_stages)
         else:
             def block_apply(p, h, rng=None, train=False):
                 return block.apply(p, h, rng=rng, train=train,

@@ -42,6 +42,9 @@ class GPT2Config:
     # GPipe microbatch count under a pipe axis (None = pipe size). Bubble
     # fraction is (P-1)/(M+P-1): raise M to amortise.
     pipeline_microbatches: int | None = None
+    # Megatron interleaved schedule: each device owns v non-contiguous
+    # layer chunks (parallel/pipeline.py::pipeline_blocks)
+    virtual_stages: int = 1
     # rematerialise blocks on backward (jax.checkpoint): ~2-4x batch for one
     # extra forward — the HBM-bound trade (proven: B=32 GPT-2-small fits one
     # v5e chip with remat; B=16 doesn't without)
@@ -142,7 +145,8 @@ class GPT2:
                 and mesh.shape["pipe"] > 1):
             x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
                                 num_microbatches=c.pipeline_microbatches,
-                                rng=layers_rng, train=train, remat=c.remat)
+                                rng=layers_rng, train=train, remat=c.remat,
+                                virtual_stages=c.virtual_stages)
         else:
             x = scan_blocks(block.apply, params["blocks"], x,
                             rng=layers_rng, train=train, remat=c.remat,

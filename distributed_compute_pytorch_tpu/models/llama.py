@@ -48,6 +48,8 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-6
     pipeline_microbatches: int | None = None
+    # Megatron interleaved schedule (parallel/pipeline.py)
+    virtual_stages: int = 1
     remat: bool | str = False      # True/"block" per-block; "stage" = 1F1B
                                    # memory profile under a pipe mesh
     unroll_layers: bool = True
@@ -176,15 +178,17 @@ class LlamaBlock:
         exact for variable-length batches (``slot_mask`` keeps the pad
         slots unattended).
         """
+        from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+            cache_insert)
         c = self.config
         d, hd = c.d_model, c.head_dim
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
         h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
         q, k, v = self._qkv(params, h, jnp.atleast_1d(pos))
-        cache = {"k": lax.dynamic_update_slice_in_dim(
-                     cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
-                 "v": lax.dynamic_update_slice_in_dim(
-                     cache["v"], v.astype(cache["v"].dtype), pos, axis=2)}
+        # in-place slot write on TPU — XLA's DUS copies the whole cache
+        # every tick otherwise (see ops/pallas/cache_update.py)
+        cache = {"k": cache_insert(cache["k"], k, pos),
+                 "v": cache_insert(cache["v"], v, pos)}
         o = A.cached_attention(q, cache["k"], cache["v"], pos,
                                slot_mask=slot_mask)
         x = x + dense(c.num_heads * hd, d).apply(params["o"],
@@ -248,7 +252,8 @@ class LlamaLM:
                 and mesh.shape["pipe"] > 1):
             x = pipeline_blocks(block.apply, params["blocks"], x, mesh,
                                 num_microbatches=c.pipeline_microbatches,
-                                rng=rng, train=train, remat=c.remat)
+                                rng=rng, train=train, remat=c.remat,
+                                virtual_stages=c.virtual_stages)
         else:
             x = scan_blocks(block.apply, params["blocks"], x,
                             rng=rng, train=train, remat=c.remat,

@@ -184,6 +184,8 @@ class MoETransformerConfig:
     # (per-pipeline-stage tick, the 1F1B memory profile — pipe meshes)
     remat: bool | str = False
     pipeline_microbatches: int | None = None   # GPipe M (None = pipe size)
+    # Megatron interleaved schedule (parallel/pipeline.py)
+    virtual_stages: int = 1
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -283,7 +285,8 @@ class MoETransformerLM:
             x, aux = pipeline_blocks(
                 self._block_apply, params["blocks"], x, mesh,
                 num_microbatches=c.pipeline_microbatches, rng=rng,
-                train=train, remat=c.remat, aux_init=zeros)
+                train=train, remat=c.remat, aux_init=zeros,
+                virtual_stages=c.virtual_stages)
             lb, z, dr = (aux["lb_loss"], aux["z_loss"],
                          aux["dropped_fraction"])
         else:

@@ -197,6 +197,8 @@ class TransformerBlock:
         are bidirectional (BERT) and have no autoregressive decode.
         """
         assert self.causal and self.pre_ln, "decode needs a causal pre-LN block"
+        from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+            cache_insert)
         d = self.d_model
         h = L.LayerNorm(d).apply(params["ln1"], x)
         qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
@@ -204,10 +206,10 @@ class TransformerBlock:
         q = A.split_heads(q, self.num_heads)
         k = A.split_heads(k, self.num_heads)
         v = A.split_heads(v, self.num_heads)
-        cache = {"k": lax.dynamic_update_slice_in_dim(
-                     cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
-                 "v": lax.dynamic_update_slice_in_dim(
-                     cache["v"], v.astype(cache["v"].dtype), pos, axis=2)}
+        # in-place slot write on TPU — XLA's DUS copies the whole cache
+        # every tick otherwise (see ops/pallas/cache_update.py)
+        cache = {"k": cache_insert(cache["k"], k, pos),
+                 "v": cache_insert(cache["v"], v, pos)}
         o = A.cached_attention(q, cache["k"], cache["v"], pos,
                                slot_mask=slot_mask)
         x = x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o))

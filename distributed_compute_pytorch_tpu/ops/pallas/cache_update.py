@@ -1,0 +1,96 @@
+"""In-place KV-cache slot write — the decode-loop Pallas kernel.
+
+Why this exists (measured on TPU v5 lite, 2026-07-30, decode-tick probe):
+``lax.dynamic_update_slice`` on a scan-carried KV cache is NOT lowered
+in place by XLA here — every tick copies the whole cache to a fresh
+buffer. For the 124M-param Llama decode rung (12 layers x [16, 4, 384,
+64] bf16 k+v = 75 MB) that copy costs **0.33 ms/tick**, 44% of the
+0.75 ms tick; donation, ``fori_loop`` vs ``scan``, stacked-vs-split
+caches and time-minor layouts were all probed and all copy. This kernel
+writes ONLY the 8-slot block containing ``pos`` and aliases the cache
+buffer through ``input_output_aliases`` — measured **0.074 ms/tick**
+for the same 24-cache update pattern, 4.5x less, taking the whole tick
+from ~0.79 to ~0.53 ms.
+
+Mechanics: TPU block shapes need the last two dims (sublane x lane)
+divisible by (8, 128) or equal to the array dims, so the minimal
+writable window on the time axis is 8 slots. The kernel DMAs that
+8-slot block in, overwrites row ``pos % 8`` with the update via a
+vectorized select (Mosaic rejects dynamic vector stores on that axis),
+and DMAs it back — 8 KB of traffic instead of 75 MB. Aliasing keeps
+every other block of the cache untouched in the SAME buffer, which XLA
+honours through scan carries.
+
+SPMD caveat (same as ``fused_adamw``): a pallas custom call is opaque
+to the GSPMD partitioner — sharded operands would be all-gathered into
+it. Callers must use it only on unsharded caches (single-chip decode);
+``models/*.decode_step`` fall back to ``dynamic_update_slice`` when a
+mesh is active.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_WINDOW = 8    # minimal sublane-aligned window on the time axis
+
+
+def _insert_kernel(pos_ref, upd_ref, cache_ref, out_ref):
+    r = pos_ref[0] % _WINDOW
+    blk = cache_ref[...]
+    slot = lax.broadcasted_iota(jnp.int32, blk.shape, 2)
+    out_ref[...] = jnp.where(slot == r, upd_ref[...], blk)
+
+
+def cache_insert_pallas(cache, upd, pos, *, interpret: bool = False):
+    """``cache [B, Hk, T, hd]`` with ``upd [B, Hk, 1, hd]`` written at
+    time slot ``pos`` (traced scalar), in place. Requires ``T % 8 == 0``
+    (cache lengths here are multiples of 128 anyway). ``interpret``
+    runs the kernel in the Pallas interpreter (CPU correctness tests)."""
+    b, hk, t, hd = cache.shape
+    assert t % _WINDOW == 0, (t,)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((b, hk, 1, hd), lambda i, pos_ref: (0, 0, 0, 0)),
+            pl.BlockSpec((b, hk, _WINDOW, hd),
+                         lambda i, pos_ref: (0, 0, pos_ref[0] // _WINDOW, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, hk, _WINDOW, hd),
+                               lambda i, pos_ref:
+                               (0, 0, pos_ref[0] // _WINDOW, 0)),
+    )
+    return pl.pallas_call(
+        _insert_kernel,
+        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+        grid_spec=grid_spec,
+        # alias the CACHE operand (index counts the scalar-prefetch arg:
+        # 0=pos, 1=upd, 2=cache) onto the output: the kernel touches one
+        # 8-slot block; every other block stays in place, no copy
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(jnp.atleast_1d(pos).astype(jnp.int32), upd.astype(cache.dtype), cache)
+
+
+def cache_insert(cache, upd, pos):
+    """Dispatcher: the in-place Pallas kernel on an unsharded TPU path,
+    ``dynamic_update_slice`` elsewhere (CPU tests; sharded generation,
+    where a pallas call would defeat the GSPMD layout).
+
+    The sharding caveat is enforced MECHANICALLY: the kernel engages only
+    on a single-device process (next to the no-mesh-context check — a
+    bench caller can batch-shard the prompt over a multi-chip mesh
+    without entering a mesh context, and GSPMD would then have to
+    gather the whole cache into the opaque custom call every tick)."""
+    from distributed_compute_pytorch_tpu.core.mesh import current_mesh
+    t = cache.shape[2]
+    if (jax.default_backend() == "tpu" and current_mesh() is None
+            and jax.device_count() == 1 and t % _WINDOW == 0):
+        return cache_insert_pallas(cache, upd, pos)
+    return lax.dynamic_update_slice_in_dim(
+        cache, upd.astype(cache.dtype), pos, axis=2)

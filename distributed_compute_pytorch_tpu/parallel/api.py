@@ -143,10 +143,16 @@ def pick_strategy(mesh: Mesh, model, warn: Callable[[str], None] | None = None):
 
 
 def tree_specs(strategy, params: PyTree, mesh: Mesh) -> PyTree:
-    """PartitionSpec pytree matching ``params``' structure."""
+    """PartitionSpec pytree matching ``params``' structure (accepts
+    abstract ``jax.eval_shape`` trees — shape via attribute, not
+    ``np.shape``, which cannot asarray a ShapeDtypeStruct)."""
+    def _shape(leaf):
+        s = getattr(leaf, "shape", None)   # () is a real (scalar) shape
+        return tuple(s) if s is not None else np.shape(leaf)
+
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: strategy.spec_for(_path_str(path),
-                                             np.shape(leaf), mesh),
+                                             _shape(leaf), mesh),
         params)
 
 

@@ -143,7 +143,8 @@ def _block_extra_kwargs(block_apply) -> frozenset:
 def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
                     axis: str = "pipe", *, num_microbatches: int | None = None,
                     rng=None, train: bool = False,
-                    remat: bool | str = False, kv_mask=None, aux_init=None):
+                    remat: bool | str = False, kv_mask=None, aux_init=None,
+                    virtual_stages: int = 1):
     """Run stacked layers as a GPipe pipeline over ``mesh``'s ``axis``.
 
     Args:
@@ -170,6 +171,23 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         unpipelined full-batch value, since microbatches are equal-sized.
         Warmup/drain ticks (stage ``s`` active only for ``s <= t < s+M``)
         are excluded. The return becomes ``(y, aux_total)``.
+      virtual_stages: Megatron-style INTERLEAVED schedule. With ``v > 1``
+        each device owns ``v`` non-contiguous layer chunks (chunk ``c`` of
+        device ``s`` holds global layers of logical stage ``c*P + s``), so
+        consecutive logical stages sit on consecutive devices and the ring
+        permute is unchanged — only the per-tick chunk selection differs.
+        The pipeline becomes ``v*P`` chunk-granularity stages: ``M + v*P -
+        1`` ticks of ``L/(v*P)``-layer cost, vs GPipe's ``M + P - 1``
+        ticks of ``L/P``-layer cost — total compiled work drops from
+        ``v*(M+P-1)`` to ``M + v*P - 1`` chunk-units (e.g. v=2, P=4, M=4:
+        11 vs 14, the bubble shrinking toward ``(P-1)/v`` stage-units as
+        the Megatron paper prescribes). Constraint: ``M <= P`` — the
+        conflict-free lockstep condition (a device would otherwise need
+        two chunks in one tick); raise ``P`` or lower ``M``, and note
+        GPipe's raise-M bubble lever is exactly what interleaving
+        replaces. Layers are re-gathered into the interleaved layout per
+        step (pre-permuting storage would avoid that cost; documented
+        trade).
 
     When the mesh also carries a ``seq`` axis > 1, the region goes manual
     over BOTH ``pipe`` and ``seq``: activations are seq-split, the mask
@@ -221,7 +239,35 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    v = virtual_stages
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
+    if v > 1:
+        if L % (P_size * v):
+            raise ValueError(f"{L} layers not divisible by pipe*virtual "
+                             f"= {P_size}*{v}")
+        if M > P_size:
+            # conflict-free lockstep condition: with M > P a device would
+            # owe two chunks in one tick (logical stages P apart both
+            # live). Interleaving replaces the raise-M bubble lever.
+            raise ValueError(
+                f"interleaved schedule needs num_microbatches <= pipe "
+                f"({M} > {P_size}); lower M or raise virtual_stages")
+        # re-gather the stacked layers into the interleaved layout: the
+        # pipe-sharded dim holds each device's v chunks contiguously
+        # (local[c*L_chunk + l] = global[(c*P + s)*L_chunk + l])
+        import numpy as np
+        L_chunk_ = L // (P_size * v)
+        perm_idx = np.empty(L, np.int32)
+        for s_ in range(P_size):
+            for c_ in range(v):
+                lo = s_ * (L // P_size) + c_ * L_chunk_
+                src = (c_ * P_size + s_) * L_chunk_
+                perm_idx[lo:lo + L_chunk_] = np.arange(src, src + L_chunk_)
+        idx = jnp.asarray(perm_idx)
+        stacked_params = jax.tree.map(lambda a: a[idx], stacked_params)
     L_local = L // P_size
+    L_chunk = L_local // v
     mb = B // M
     perm = [(i, (i + 1) % P_size) for i in range(P_size)]
     masked = kv_mask is not None   # signature validated above
@@ -239,13 +285,17 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         # checkpoint — train/manual_axes stay closed-over statics
         call_block = jax.checkpoint(call_block, prevent_cse=False)
 
-    def stage_fn(params_local, h, mk, stage, mb_id):
+    def stage_fn(params_slice, h, mk, layer_offset, mb_id):
+        """Apply a contiguous run of layers (a full stage for GPipe, one
+        chunk for the interleaved schedule); ``layer_offset`` is the run's
+        first GLOBAL layer index (drives the per-layer dropout keys)."""
+        n_run = num_layers(params_slice)
         def layer_body(carry, scanned):
             h, acc = carry
             i, p = scanned
             r = None
             if rng is not None and train:
-                g = stage * L_local + i          # global layer index
+                g = layer_offset + i             # global layer index
                 r = jax.random.fold_in(jax.random.fold_in(rng, g), mb_id)
                 if seq_manual:
                     # independent dropout bits per seq chunk
@@ -264,7 +314,7 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
                                 to="varying"),
             aux_init) if with_aux else ()
         (h, acc), _ = lax.scan(layer_body, (h, acc0),
-                               (jnp.arange(L_local), params_local))
+                               (jnp.arange(n_run), params_slice))
         return h, acc
 
     if remat == "stage":
@@ -309,7 +359,7 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             inp = jnp.where(stage == 0, x_mb[t % M], state)
             mb_id = (t - stage) % M              # microbatch this stage holds
             mk = mask_mb[mb_id] if masked else None
-            y, aux = stage_fn(params_local, inp, mk, stage, mb_id)
+            y, aux = stage_fn(params_local, inp, mk, stage * L_local, mb_id)
             if with_aux:
                 # warmup/drain ticks compute garbage: count a stage's aux
                 # only while it holds a real microbatch
@@ -325,8 +375,43 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             state = lax.ppermute(y, axis, perm)
             return (state, outputs, aux_acc), None
 
+        def tick_interleaved(carry, t):
+            # chunk-granularity tick: logical stage j = c*P + s is live
+            # for microbatch rel % P at tick t = j + mb (rel = t - s);
+            # consecutive logical stages sit on consecutive devices, so
+            # the same ring permute carries activations chunk-to-chunk
+            state, outputs, aux_acc = carry
+            rel = t - stage
+            c = jnp.clip(rel // P_size, 0, v - 1)
+            active = jnp.logical_and(
+                rel >= 0,
+                jnp.logical_and(rel % P_size < M, rel // P_size < v))
+            mb_id = jnp.where(active, rel % P_size, 0)
+            mk = mask_mb[mb_id] if masked else None
+            inp = jnp.where(jnp.logical_and(stage == 0, c == 0),
+                            x_mb[mb_id % M], state)
+            params_chunk = jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, c * L_chunk, L_chunk,
+                                                   axis=0), params_local)
+            offset = (c * P_size + stage) * L_chunk
+            y, aux = stage_fn(params_chunk, inp, mk, offset, mb_id)
+            if with_aux:
+                live = active.astype(jnp.float32)
+                aux_acc = jax.tree.map(lambda a, s: a + live * s,
+                                       aux_acc, aux)
+            # chunk v-1 of the last device is the final logical stage
+            finish = jnp.logical_and(
+                jnp.logical_and(stage == P_size - 1, c == v - 1), active)
+            out_idx = mb_id % M
+            outputs = outputs.at[out_idx].set(
+                jnp.where(finish, y, outputs[out_idx]))
+            state = lax.ppermute(y, axis, perm)
+            return (state, outputs, aux_acc), None
+
+        n_ticks = (M + v * P_size - 1) if v > 1 else (M + P_size - 1)
         (state, outputs, aux_acc), _ = lax.scan(
-            tick, (state, outputs, aux_acc), jnp.arange(M + P_size - 1))
+            tick_interleaved if v > 1 else tick,
+            (state, outputs, aux_acc), jnp.arange(n_ticks))
         # only the last stage holds real outputs; mask + psum replicates
         # them across the pipe axis (single cross-stage collective)
         outputs = jnp.where(stage == P_size - 1, outputs, 0)

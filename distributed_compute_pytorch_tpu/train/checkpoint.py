@@ -317,10 +317,15 @@ def _restore_sharded(path: str, template, shardings=None, *,
         if key not in entries:
             raise KeyError(f"checkpoint missing leaf {key!r}")
         pieces = entries[key]
-        is_key = isinstance(leaf, jax.Array) and jnp.issubdtype(
-            leaf.dtype, jax.dtypes.prng_key)
+        is_key = _is_key_leaf(leaf)
+        if is_key and not isinstance(leaf, jax.Array):
+            # key-data shape depends on the key impl; abstract templates
+            # (params-only restores) never carry key leaves
+            raise TypeError(
+                f"leaf {key!r} is a PRNG key; the v2 restore needs a "
+                f"concrete template for key leaves")
         shape = tuple(jax.random.key_data(leaf).shape if is_key
-                      else np.shape(leaf))
+                      else _leaf_shape(leaf))
         dtype = (jax.random.key_data(leaf).dtype if is_key
                  else getattr(leaf, "dtype", None))
         saved_shape = pieces[0][3]
@@ -415,12 +420,28 @@ class AsyncCheckpointer:
         self.close()
 
 
+def _leaf_shape(leaf) -> tuple:
+    """Template-leaf shape; works for concrete arrays AND abstract
+    ``jax.eval_shape`` templates (``np.shape`` would try to asarray a
+    ShapeDtypeStruct)."""
+    s = getattr(leaf, "shape", None)
+    return tuple(s) if s is not None else np.shape(leaf)
+
+
+def _is_key_leaf(leaf) -> bool:
+    dt = getattr(leaf, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key)
+
+
 def restore(path: str, template, shardings=None, *, _prefix: str = ""):
     """Read a checkpoint back into ``template``'s pytree structure.
 
-    ``template`` provides structure/dtypes (e.g. a freshly-initialised
-    TrainState); ``shardings`` (optional, same structure) places each leaf
-    directly into its mesh layout — restore-into-FSDP works without ever
+    ``template`` provides structure/shapes/dtypes — a freshly-initialised
+    TrainState, or an ABSTRACT ``jax.eval_shape`` tree (what
+    ``dcp-generate --mesh`` passes: a bigger-than-one-chip checkpoint must
+    never materialise unsharded params just to build a template);
+    ``shardings`` (optional, same structure) places each leaf directly
+    into its mesh layout — restore-into-FSDP works without ever
     materialising the full model on one device per leaf batch. Both formats
     restore under ANY mesh (elastic resize): the v1 file holds unsharded
     leaves; the v2 directory is reassembled span-by-span.
@@ -452,11 +473,10 @@ def _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
         if key not in available:
             raise KeyError(f"checkpoint missing leaf {key!r}")
         arr = z[key]
-        if isinstance(leaf, jax.Array) and jnp.issubdtype(
-                leaf.dtype, jax.dtypes.prng_key):
+        if _is_key_leaf(leaf):
             new = jax.random.wrap_key_data(jnp.asarray(arr))
         else:
-            want = np.shape(leaf)
+            want = _leaf_shape(leaf)
             if want and arr.shape != want:
                 # same contract as the v2 path: a silently wrong-shaped
                 # leaf (model config drifted since the save) must not load

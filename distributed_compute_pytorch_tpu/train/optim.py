@@ -121,10 +121,15 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
             optax.scale_by_schedule(lambda s: -steplr(lr, gamma, steps_per_epoch)(s)),
         ))
     if name in ("adamw", "adamw_fused"):
+        # decay_steps must exceed the EFFECTIVE warmup (forced >= 1):
+        # optax subtracts warmup from decay_steps for the cosine phase, and
+        # a tiny dataset (total=1, e.g. one batch per epoch) would hand
+        # cosine_decay_schedule zero steps -> ValueError
+        eff_warmup = max(warmup_steps, 1)
         sched = optax.warmup_cosine_decay_schedule(
             init_value=0.0, peak_value=lr,
-            warmup_steps=max(warmup_steps, 1),
-            decay_steps=max(total, warmup_steps + 1))
+            warmup_steps=eff_warmup,
+            decay_steps=max(total, eff_warmup + 1))
         if name == "adamw_fused":
             # single-pass Pallas update kernel (see ops/pallas/fused_adamw):
             # same recurrence as optax.adamw, ~half the optimizer HBM traffic

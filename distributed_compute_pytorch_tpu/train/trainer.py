@@ -63,15 +63,18 @@ class Trainer:
         self.mesh = make_mesh(config.mesh)
 
         fallback_ok = not config.require_real_data
+        data_kw = ({"seq_len": config.seq_len,
+                    "tokenizer": config.tokenizer}
+                   if config.dataset == "text" else {})
         self.train_data = train_data if train_data is not None else \
             load_dataset(config.dataset, config.data_dir, "train",
                          synthetic_fallback=fallback_ok,
-                         download=config.download)
+                         download=config.download, **data_kw)
         self.eval_data = eval_data if eval_data is not None else \
             (self.train_data if config.eval_on_train
              else load_dataset(config.dataset, config.data_dir, "test",
                                synthetic_fallback=fallback_ok,
-                               download=config.download))
+                               download=config.download, **data_kw))
 
         def _feeder(data, shuffle):
             """In-memory datasets fancy-index through DeviceFeeder; sharded
@@ -215,12 +218,19 @@ class Trainer:
                 kw["image_size"] = tuple(int(s) for s in inputs.shape[1:3])
         if cfg.model in ("bert", "gpt2", "moe", "llama"):
             kw["preset"] = cfg.model_preset
-            if cfg.model_preset == "tiny" or cfg.dataset.startswith("synthetic"):
+            if (cfg.model_preset == "tiny"
+                    or cfg.dataset.startswith("synthetic")
+                    or cfg.dataset == "text"):
+                # text: vocab must match the tokenizer exactly (ids outside
+                # the embedding would clamp-gather silently)
                 kw["vocab_size"] = max(self.train_data.num_classes, 4)
                 kw["max_seq_len"] = int(inputs.shape[1])
         if (cfg.model in ("bert", "gpt2", "llama", "moe")
                 and cfg.microbatches):
             kw["pipeline_microbatches"] = cfg.microbatches
+        if (cfg.model in ("bert", "gpt2", "llama", "moe")
+                and cfg.virtual_stages > 1):
+            kw["virtual_stages"] = cfg.virtual_stages
         if cfg.seq_shard_activations:
             if cfg.model in ("bert", "gpt2", "llama"):
                 kw["seq_shard_activations"] = True

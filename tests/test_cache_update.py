@@ -1,0 +1,55 @@
+"""In-place KV-cache slot write (ops/pallas/cache_update.py): kernel ==
+dynamic_update_slice for every slot, and the dispatcher picks the right
+engine per backend/mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+    cache_insert, cache_insert_pallas)
+
+
+@pytest.mark.parametrize("pos", [0, 1, 7, 8, 63, 127])
+def test_kernel_matches_dus_every_slot(pos):
+    """Interpreter-mode kernel == DUS at window-edge and interior slots."""
+    B, HK, T, HD = 2, 3, 128, 64
+    cache = jax.random.normal(jax.random.key(0),
+                              (B, HK, T, HD)).astype(jnp.bfloat16)
+    upd = jax.random.normal(jax.random.key(1),
+                            (B, HK, 1, HD)).astype(jnp.bfloat16)
+    ref = lax.dynamic_update_slice_in_dim(cache, upd, pos, axis=2)
+    got = jax.jit(
+        lambda c, u, p: cache_insert_pallas(c, u, p, interpret=True)
+    )(cache, upd, jnp.int32(pos))
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_dispatcher_falls_back_off_tpu():
+    """On CPU the dispatcher must use plain DUS (and be correct)."""
+    B, HK, T, HD = 1, 2, 16, 8
+    cache = jnp.zeros((B, HK, T, HD), jnp.float32)
+    upd = jnp.ones((B, HK, 1, HD), jnp.float32)
+    out = jax.jit(cache_insert)(cache, upd, jnp.int32(5))
+    assert float(out[0, 0, 5].sum()) == HD
+    assert float(out.sum()) == HK * HD
+
+
+def test_dispatcher_in_scan_traced_pos():
+    """The decode pattern: traced position inside lax.scan."""
+    B, HK, T, HD = 1, 1, 16, 8
+    cache0 = jnp.zeros((B, HK, T, HD), jnp.float32)
+
+    @jax.jit
+    def run(cache):
+        def tick(c, i):
+            upd = jnp.full((B, HK, 1, HD), i + 1, jnp.float32)
+            return cache_insert(c, upd, i), None
+        out, _ = lax.scan(tick, cache, jnp.arange(4))
+        return out
+    out = np.asarray(run(cache0))
+    for i in range(4):
+        assert (out[0, 0, i] == i + 1).all()
+    assert (out[0, 0, 4:] == 0).all()

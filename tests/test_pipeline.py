@@ -355,3 +355,126 @@ def test_trainer_mesh_spec_engages_pipeline(tmp_path):
     assert qkv.sharding.shard_shape(qkv.shape)[0] == 1  # 2 layers / pipe=2
     res = t.fit()
     assert np.isfinite(res["loss"])
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) schedule — VERDICT r3 #5
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("v,M,L", [(2, 2, 8), (2, 4, 8), (4, 2, 16)])
+def test_interleaved_matches_scan(devices8, v, M, L):
+    """v virtual stages == plain scan (the layer re-gather into the
+    interleaved layout and the chunk-granularity schedule are
+    numerics-transparent)."""
+    mesh = make_mesh("data=2,pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0), L=L)
+    x = jax.random.normal(jax.random.key(1), (8, 4, 16))
+
+    ref = jax.jit(lambda p, x: scan_blocks(apply, p, x))(params, x)
+    got = jax.jit(lambda p, x: pipeline_blocks(
+        apply, p, x, mesh, num_microbatches=M, virtual_stages=v))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_gradients_match_scan(devices8):
+    mesh = make_mesh("pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(2), L=8)
+    x = jax.random.normal(jax.random.key(3), (4, 4, 16))
+
+    def loss_scan(p):
+        return jnp.sum(scan_blocks(apply, p, x) ** 2)
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_blocks(apply, p, x, mesh,
+                                       num_microbatches=4,
+                                       virtual_stages=2) ** 2)
+
+    g_ref = jax.jit(jax.grad(loss_scan))(params)
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_pipe)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_interleaved_fewer_chunk_ticks_than_gpipe(devices8):
+    """The schedule property itself: at equal M the interleaved pipeline
+    runs M + v*P - 1 chunk ticks (each 1/v of a stage) where GPipe runs
+    (M + P - 1) stage ticks = v*(M + P - 1) chunk-equivalents. Verified
+    structurally from the traced program's scan trip counts."""
+    mesh = make_mesh("pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0), L=8)
+    x = jax.random.normal(jax.random.key(1), (4, 4, 16))
+
+    def scan_lengths(fn):
+        lengths = []
+        def walk(jaxpr):
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "scan":
+                    lengths.append(eqn.params["length"])
+                for sub in jax.core.jaxprs_in_params(eqn.params):
+                    walk(sub)
+        walk(jax.make_jaxpr(fn)(params, x).jaxpr)
+        return lengths
+
+    P_, v, M, L = 4, 2, 4, 8
+    gpipe = scan_lengths(lambda p, x: pipeline_blocks(
+        apply, p, x, mesh, num_microbatches=M))
+    inter = scan_lengths(lambda p, x: pipeline_blocks(
+        apply, p, x, mesh, num_microbatches=M, virtual_stages=v))
+    assert M + P_ - 1 in gpipe, gpipe         # 7 stage ticks
+    assert L // P_ in gpipe, gpipe            # of 2 layers each = 14 units
+    assert M + v * P_ - 1 in inter, inter     # 11 chunk ticks
+    assert L // (P_ * v) in inter, inter      # of 1 layer each = 11 units
+    # total block applications per device drop
+    g_total = (M + P_ - 1) * (L // P_)
+    i_total = (M + v * P_ - 1) * (L // (P_ * v))
+    assert i_total < g_total, (i_total, g_total)
+
+
+def test_interleaved_validates(devices8):
+    mesh = make_mesh("pipe=4", devices=devices8)
+    apply, params = _stacked_mlp(jax.random.key(0), L=8)
+    x = jax.random.normal(jax.random.key(1), (8, 4, 16))
+    with pytest.raises(ValueError, match="microbatches <= pipe"):
+        pipeline_blocks(apply, params, x, mesh, num_microbatches=8,
+                        virtual_stages=2)
+    with pytest.raises(ValueError, match="not divisible by pipe"):
+        pipeline_blocks(apply, params, x, mesh, num_microbatches=4,
+                        virtual_stages=3)
+
+
+def test_interleaved_gpt2_step_matches_dp(devices8):
+    """Full train-step parity: GPT-2 (4 layers) under data=2,pipe=2 with
+    v=2 == pure DP — dropout keys, loss and updated params all line up."""
+    import dataclasses
+
+    data = synthetic_lm(16, seq_len=16, vocab=256, seed=4)
+
+    def run(spec, v):
+        mesh = make_mesh(spec, devices=devices8)
+        cfg = dataclasses.replace(GPT2Config.tiny(), num_layers=4,
+                                  virtual_stages=v,
+                                  pipeline_microbatches=2 if v > 1 else None)
+        model = GPT2(cfg)
+        feed = DeviceFeeder(data, mesh, 16, shuffle=False)
+        tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+        strategy = (ShardingRules(rules=model.partition_rules(),
+                                  fallback=DataParallel())
+                    if "pipe" in spec else DataParallel())
+        init_fn, train_step, _ = make_step_fns(model, tx, mesh, strategy)
+        state = init_fn(jax.random.key(0))
+        (x, y), = list(feed.epoch(0))
+        for _ in range(2):
+            state, m = train_step(state, x, y)
+        return jax.device_get(state.params), float(m["loss"])
+
+    p_ref, l_ref = run("data=8", 1)
+    p_int, l_int = run("data=2,pipe=2", 2)
+    np.testing.assert_allclose(l_int, l_ref, rtol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_int)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-4, atol=3e-5)

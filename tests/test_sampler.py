@@ -33,3 +33,15 @@ def test_drop_last():
     s = ShardedSampler(num_examples=1000, global_batch=128, drop_last=True)
     assert s.num_batches == 7
     assert s.epoch_order(0).shape == (7, 128)
+
+
+def test_dataset_smaller_than_one_batch_pads_cyclically():
+    """pad > num_examples (tiny eval split, big global batch) must cycle
+    the order rather than truncate (regression: reshape ValueError)."""
+    from distributed_compute_pytorch_tpu.data.sampler import ShardedSampler
+
+    s = ShardedSampler(num_examples=2, global_batch=8, shuffle=False)
+    order = s.epoch_order(0)
+    assert order.shape == (1, 8)
+    # every entry is a valid example index, both examples appear
+    assert set(order.ravel()) == {0, 1}

@@ -1,0 +1,202 @@
+"""Real-text LM pipeline (VERDICT r3 #4): tokenizers round-trip, the text
+dataset is deterministic and leak-free, training on a real corpus lowers
+loss, and dcp-generate produces text."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.data.datasets import text_lm
+from distributed_compute_pytorch_tpu.data.tokenizer import (
+    BPETokenizer, ByteTokenizer, build_tokenizer)
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "she sells sea shells by the sea shore. "
+    "how much wood would a woodchuck chuck if a woodchuck could chuck "
+    "wood? peter piper picked a peck of pickled peppers. "
+) * 150
+
+
+# --------------------------------------------------------------------------
+# tokenizers
+# --------------------------------------------------------------------------
+
+
+def test_byte_tokenizer_round_trip():
+    tok = ByteTokenizer()
+    for s in ("hello world", "naïve café — ünïcödé ✓", "", "\n\t\0"):
+        assert tok.decode(tok.encode(s)) == s
+    assert tok.vocab_size == 259
+    assert tok.pad_id == 256 and tok.bos_id == 257 and tok.eos_id == 258
+    # specials decode to nothing
+    assert tok.decode([104, 105, tok.eos_id]) == "hi"
+
+
+def test_bpe_tokenizer_round_trip_and_compression():
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    assert len(tok.merges) == 300 - 259
+    assert tok.vocab_size == 300
+    for s in ("the quick brown fox", "unseen zebra text!", "ünïcödé"):
+        assert tok.decode(tok.encode(s)) == s
+    # merges actually compress the training distribution
+    n_bytes = len(CORPUS.encode())
+    n_tokens = len(tok.encode(CORPUS))
+    assert n_tokens < 0.8 * n_bytes, (n_tokens, n_bytes)
+
+
+def test_bpe_save_load_identical(tmp_path):
+    tok = BPETokenizer.train(CORPUS, vocab_size=280)
+    path = str(tmp_path / "tok.json")
+    tok.save(path)
+    tok2 = build_tokenizer(path)
+    assert tok2.merges == tok.merges
+    assert tok2.encode(CORPUS[:500]) == tok.encode(CORPUS[:500])
+
+
+def test_bpe_train_stops_when_dry():
+    """A corpus with no repeating pair stops merging early instead of
+    fabricating vocab."""
+    tok = BPETokenizer.train("abcdefg", vocab_size=400)
+    assert len(tok.merges) == 0
+    assert tok.decode(tok.encode("abcdefg")) == "abcdefg"
+
+
+def test_build_tokenizer_errors():
+    with pytest.raises(ValueError, match="tokenizer"):
+        build_tokenizer("no-such-file.json")
+
+
+# --------------------------------------------------------------------------
+# text dataset
+# --------------------------------------------------------------------------
+
+
+def _write_corpus(tmp_path, text=CORPUS):
+    p = tmp_path / "corpus.txt"
+    p.write_text(text, encoding="utf-8")
+    return str(p)
+
+
+def test_text_dataset_shapes_and_determinism(tmp_path):
+    path = _write_corpus(tmp_path)
+    a = text_lm(path, seq_len=64, tokenizer="byte", split="train")
+    b = text_lm(path, seq_len=64, tokenizer="byte", split="train")
+    np.testing.assert_array_equal(a.inputs, b.inputs)
+    assert a.inputs.shape[1] == 64
+    assert a.inputs.dtype == np.int32
+    assert a.num_classes == 259          # tokenizer vocab, not max-id-seen
+    # round-trip: the first window decodes back to the corpus head
+    tok = ByteTokenizer()
+    assert tok.decode(a.inputs[0]) == CORPUS[:64]
+
+
+def test_text_dataset_split_is_disjoint_tail(tmp_path):
+    """train + test partition the window sequence, test = contiguous tail
+    (positional disjointness; a repetitive corpus can legally repeat
+    window VALUES across splits)."""
+    path = _write_corpus(tmp_path)
+    tr = text_lm(path, seq_len=64, split="train")
+    te = text_lm(path, seq_len=64, split="test")
+    assert len(te) >= 1 and len(tr) >= 1
+    tok = ByteTokenizer()
+    ids = tok.encode(CORPUS) + [tok.eos_id]
+    n_seq = len(ids) // 64
+    full = np.asarray(ids[:n_seq * 64], np.int32).reshape(n_seq, 64)
+    np.testing.assert_array_equal(
+        np.concatenate([tr.inputs, te.inputs]), full)
+
+
+def test_text_dataset_directory_of_files(tmp_path):
+    d = tmp_path / "docs"
+    d.mkdir()
+    (d / "a.txt").write_text("aaaa " * 200, encoding="utf-8")
+    (d / "b.txt").write_text("bbbb " * 200, encoding="utf-8")
+    ds = text_lm(str(d), seq_len=32, split="train")
+    tok = ByteTokenizer()
+    # eos separator is present in the stream (document boundary)
+    assert (ds.inputs == tok.eos_id).sum() >= 1
+
+
+def test_text_dataset_too_short_raises(tmp_path):
+    p = tmp_path / "tiny.txt"
+    p.write_text("hi", encoding="utf-8")
+    with pytest.raises(ValueError, match="too short"):
+        text_lm(str(p), seq_len=64)
+
+
+# --------------------------------------------------------------------------
+# end to end: train on text -> loss drops -> generate text
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tokenizer_kind", ["byte", "bpe"])
+def test_text_train_and_generate_end_to_end(tmp_path, capsys, devices8,
+                                            tokenizer_kind):
+    from distributed_compute_pytorch_tpu.cli_generate import main as gen_main
+    from distributed_compute_pytorch_tpu.cli_tokenizer import (
+        main as tok_main)
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    corpus = _write_corpus(tmp_path)
+    tok_spec = "byte"
+    if tokenizer_kind == "bpe":
+        tok_spec = str(tmp_path / "tok.json")
+        rc = tok_main(["--corpus", corpus, "--vocab_size", "300",
+                       "--out", tok_spec])
+        assert rc == 0
+        head = json.loads(capsys.readouterr().out.strip())
+        assert head["vocab_size"] == 300 and head["merges"] > 0
+
+    ck = str(tmp_path / "ck.npz")
+    # log_every stays SHORT: the periodic loss fetch is what keeps the
+    # CPU backend's async dispatch queue bounded (see step.py eval notes —
+    # a queue of many collective-bearing programs aborts XLA:CPU)
+    cfg = Config(batch_size=16, lr=3e-3, epochs=1, mesh="data=8",
+                 model="llama", model_preset="tiny", dataset="text",
+                 data_dir=corpus, seq_len=32, tokenizer=tok_spec,
+                 optimizer="adamw", ckpt_path=ck, log_every=10)
+    tr = Trainer(cfg)
+    vocab = tr.model.config.vocab_size
+    assert vocab == (259 if tokenizer_kind == "byte" else 300)
+    before = tr.evaluate(-1)["loss"]
+    after = tr.fit()["loss"]
+    assert after < before, (before, after)  # loss drops on real text
+
+    capsys.readouterr()
+    rc = gen_main(["--ckpt_path", ck, "--model", "llama",
+                   "--model_preset", "tiny", "--max_seq_len", "32",
+                   "--vocab_size", str(vocab),
+                   "--tokenizer", tok_spec,
+                   "--text_prompt", "the quick brown ",
+                   "--max_new_tokens", "12"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["text"].startswith("the quick brown ")
+    assert isinstance(out["text"], str) and len(out["new"]) >= 1
+
+
+def test_bpe_corpus_sidecar_cache(tmp_path):
+    """A trained-BPE corpus tokenizes once: the second text_lm call reads
+    the sidecar (corpus+merges keyed), and a corpus edit invalidates it."""
+    corpus = _write_corpus(tmp_path)
+    tok = BPETokenizer.train(CORPUS, vocab_size=280)
+    tok_path = str(tmp_path / "tok.json")
+    tok.save(tok_path)
+
+    a = text_lm(corpus, seq_len=32, tokenizer=tok_path)
+    caches = list(tmp_path.glob(".tokcache-*.npy"))
+    assert len(caches) == 1
+    b = text_lm(corpus, seq_len=32, tokenizer=tok_path)
+    np.testing.assert_array_equal(a.inputs, b.inputs)
+
+    # a corpus change must MISS the old cache (new digest), not serve
+    # stale tokens
+    (tmp_path / "corpus.txt").write_text(CORPUS + "something new.",
+                                         encoding="utf-8")
+    c = text_lm(corpus, seq_len=32, tokenizer=tok_path)
+    assert len(list(tmp_path.glob(".tokcache-*.npy"))) == 2
+    assert c.inputs.shape[0] >= a.inputs.shape[0]
