@@ -369,10 +369,19 @@ def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops):
     # activations overflow a single v5e's 16G HBM at B=8.
     # group 512 measured best on v5e (2026-07-30 sweep): 158 ms vs 169 at
     # 1024, 182 at 2048, 261 global — smaller [G, E, C] dispatch tensors
-    # beat fewer-larger groups until capacity granularity bites (dropped
-    # fraction 13.5% vs 13.1% at 1024; 256 drops more for no speed gain)
+    # beat fewer-larger groups until capacity granularity bites.
+    # capacity_factor 1.0 + SINKHORN-balanced selection (r4): the
+    # measured cf frontier with raw argmax was drop/MFU = 13.5%/0.316 at
+    # cf 1.25, 6.6%/0.285 at 1.5, 2.7%/0.244 at 2.0 — capacity padding
+    # buys drop reduction ONLY by burning active-MFU. Balancing the
+    # SELECTION instead (models/moe.py router_balance) collapses drops
+    # without the padding: measured 2.1%/0.342 at cf=1.0, 0.0%/0.317 at
+    # cf=1.25. The residual gap to ~0.38 active-MFU is the dispatch/
+    # combine einsums' non-expert FLOPs (~25% of expert compute at C=128)
+    # plus remat — inherent to the einsum (GShard) formulation at this
+    # scale; a sort-based dispatch is the known next step up.
     cfg = MoETransformerConfig(num_experts=8, top_k=2, moe_group_size=512,
-                               capacity_factor=1.25, dropout_rate=0.0,
+                               capacity_factor=1.0, dropout_rate=0.0,
                                remat=True)
     model = MoETransformerLM(cfg)
     tx = build_optimizer("adamw", lr=3e-4, gamma=1.0, steps_per_epoch=100,

@@ -51,6 +51,8 @@ class Config:
     microbatches: int | None = None  # GPipe microbatches under a pipe axis
     virtual_stages: int = 1        # Megatron interleaved pipeline: v layer
                                    # chunks per device (needs M <= pipe)
+    num_layers: int | None = None  # transformer depth override (e.g. a
+                                   # 4-layer tiny model for pipe*virtual)
     dataset: str = "mnist"         # mnist | cifar10 | synthetic-images | synthetic-lm
     optimizer: str = "adadelta"    # adadelta (reference stack) | sgd | adamw
                                    # | adamw_fused (Pallas single-pass kernel)
@@ -81,6 +83,12 @@ class Config:
     checkpoint_every: int = 0      # also checkpoint every N steps (0 = per-epoch
                                    # only); resume restarts mid-epoch exactly
     heartbeat_path: str | None = None  # liveness file, touched at log cadence
+                                       # (multi-host: a shared dir; each host
+                                       # beats into host-{i}.hb)
+    preempt_flag: str | None = None    # shared dir for COORDINATED multi-host
+                                       # preemption: any host's SIGTERM makes
+                                       # every host checkpoint at one agreed
+                                       # step (elastic.ClusterPreemption)
     supervise: bool = False        # run under the restart supervisor
     max_restarts: int = 3          # supervisor restart budget
     heartbeat_timeout: float = 300.0   # supervisor hang detection threshold (s)
@@ -168,6 +176,8 @@ class Config:
         p.add_argument("--virtual_stages", type=int, default=cls.virtual_stages,
                        help="Megatron interleaved pipeline: v layer chunks "
                             "per device (needs microbatches <= pipe)")
+        p.add_argument("--num_layers", type=int, default=None,
+                       help="transformer depth override")
         p.add_argument("--dataset", type=str, default=cls.dataset)
         p.add_argument("--optimizer", type=str, default=cls.optimizer,
                        help="adadelta (reference stack) | sgd | adamw")
@@ -200,7 +210,12 @@ class Config:
                        help="also checkpoint every N steps (0 = per-epoch "
                             "only); resume restarts mid-epoch")
         p.add_argument("--heartbeat_path", type=str, default=None,
-                       help="liveness file for external failure detection")
+                       help="liveness file for external failure detection "
+                            "(multi-host: shared dir, host-{i}.hb each)")
+        p.add_argument("--preempt_flag", type=str, default=None,
+                       help="shared dir for coordinated multi-host "
+                            "preemption (all hosts checkpoint at one "
+                            "agreed step)")
         p.add_argument("--supervise", action="store_true",
                        help="run under the restart supervisor (auto --resume "
                             "after crash/hang/preemption)")

@@ -62,6 +62,20 @@ class MoELayer:
     capacity_factor: float = 1.25
     top_k: int = 1
     group_size: int | None = None
+    # expert SELECTION scores: "sinkhorn" balances them with a few
+    # row/column normalisations before the argmax, collapsing dropped
+    # tokens (measured on the bench shapes: 7.8% -> ~0 at one iteration,
+    # vs 13.5% raw) without the capacity_factor increase that costs
+    # active-MFU (cf 2.0 measured 0.32 -> 0.24). Gates still come from
+    # the raw softmax probs of the CHOSEN experts, so the differentiable
+    # path and the aux losses are unchanged; "aux" is pure Switch/GShard
+    # argmax selection. "auto" (default) = sinkhorn for top_k=2, aux for
+    # top_k=1: the top-2 gate renormalises over the chosen pair, so a
+    # balanced-away expert still combines with weight ~1; top-1's single
+    # unnormalised gate would scale such tokens by its (near-zero) raw
+    # prob — an uncounted drop — so sinkhorn+top_k=1 is rejected.
+    router_balance: str = "auto"
+    sinkhorn_iters: int = 3
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -104,25 +118,54 @@ class MoELayer:
         ).astype(jnp.float32)                                  # [G, Ng, E]
         probs = jax.nn.softmax(logits, -1)
 
-        def slot(p, prio_count):
-            """Route one top-k slot: (onehot, queue position, keep mask).
+        balance = self.router_balance
+        if balance == "auto":
+            balance = "sinkhorn" if self.top_k == 2 else "aux"
+        elif balance == "sinkhorn" and self.top_k == 1:
+            raise ValueError(
+                "router_balance='sinkhorn' needs top_k=2: the top-1 gate "
+                "is the raw prob of the selected expert, so balanced-away "
+                "tokens would be scaled by ~0 (an uncounted drop); use "
+                "'auto' or 'aux'")
+        if balance == "sinkhorn":
+            # balanced SELECTION scores: alternate expert-marginal and
+            # token-marginal normalisation (Sinkhorn) so argmax spreads
+            # tokens near-uniformly; a stop_gradient keeps the gate path
+            # (raw probs of the chosen experts) the only gradient route,
+            # same as plain argmax selection
+            sel = probs
+            target = self.top_k * Ng / E
+            for _ in range(self.sinkhorn_iters):
+                sel = sel / jnp.maximum(sel.sum(1, keepdims=True),
+                                        1e-9) * target
+                sel = sel / jnp.maximum(sel.sum(2, keepdims=True), 1e-9)
+            sel = jax.lax.stop_gradient(sel)
+        elif balance == "aux":
+            sel = probs
+        else:
+            raise ValueError(f"router_balance must be 'auto', 'sinkhorn' "
+                             f"or 'aux', got {self.router_balance!r}")
+
+        def slot(scores, prio_count):
+            """Route one top-k slot: (onehot, queue position, keep mask,
+            gate) — selection by ``scores`` argmax, gate = raw prob of the
+            SELECTED expert (differentiable path).
 
             ``prio_count [G, E]``: expert queue occupancy from higher-
             priority slots — this slot's positions start after it."""
-            idx = jnp.argmax(p, -1)                            # [G, Ng]
+            idx = jnp.argmax(scores, -1)                       # [G, Ng]
             oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [G, Ng, E]
             pos = (jnp.cumsum(oh, axis=1) - oh) * oh           # [G, Ng, E]
             pos = pos + prio_count[:, None, :] * oh
             keep = (pos < C) * oh
-            return oh, pos, keep
+            gate = jnp.sum(probs * oh, -1)                     # [G, Ng]
+            return oh, pos, keep, gate
 
-        oh1, pos1, keep1 = slot(probs, jnp.zeros((G, E), jnp.float32))
-        gate1 = jnp.max(probs, -1)                             # [G, Ng]
+        oh1, pos1, keep1, gate1 = slot(sel, jnp.zeros((G, E), jnp.float32))
         slots = [(keep1, pos1, gate1)]
         if self.top_k == 2:
-            probs2 = probs * (1.0 - oh1)       # mask the chosen expert
-            oh2, pos2, keep2 = slot(probs2, oh1.sum(axis=1))
-            gate2 = jnp.max(probs2, -1)
+            sel2 = sel * (1.0 - oh1)           # mask the chosen expert
+            oh2, pos2, keep2, gate2 = slot(sel2, oh1.sum(axis=1))
             # GShard gate renormalisation over the two chosen experts
             denom = jnp.maximum(gate1 + gate2, 1e-9)
             slots = [(keep1, pos1, gate1 / denom),
@@ -177,6 +220,8 @@ class MoETransformerConfig:
     capacity_factor: float = 1.25
     top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     moe_group_size: int | None = None  # routing group tokens (None = global)
+    router_balance: str = "auto"       # balanced selection (see MoELayer)
+    sinkhorn_iters: int = 3
     lb_weight: float = 0.01
     z_weight: float = 1e-3
     dropout_rate: float = 0.0
@@ -213,6 +258,8 @@ class MoETransformerLM:
         c = self.config
         return MoELayer(c.d_model, c.d_ff, c.num_experts, c.capacity_factor,
                         top_k=c.top_k, group_size=c.moe_group_size,
+                        router_balance=c.router_balance,
+                        sinkhorn_iters=c.sinkhorn_iters,
                         param_dtype=c.param_dtype)
 
     def _block_init(self, key):

@@ -465,6 +465,20 @@ def restore(path: str, template, shardings=None, *, _prefix: str = ""):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _place(arr, shard):
+    """Put a host array into ``shard``'s layout; in a MULTI-PROCESS world
+    the sharding spans non-addressable devices and ``device_put`` refuses —
+    each process then contributes only its addressable shards (the same
+    contract the v2 path already uses)."""
+    if shard is None:
+        return arr
+    if getattr(shard, "is_fully_addressable", True):
+        return jax.device_put(arr, shard)
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(host.shape, shard,
+                                        lambda idx: host[idx])
+
+
 def _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
                        _prefix):
     for (path_keys, leaf), shard in zip(paths, flat_shardings):
@@ -474,20 +488,32 @@ def _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
             raise KeyError(f"checkpoint missing leaf {key!r}")
         arr = z[key]
         if _is_key_leaf(leaf):
-            new = jax.random.wrap_key_data(jnp.asarray(arr))
-        else:
-            want = _leaf_shape(leaf)
-            if want and arr.shape != want:
-                # same contract as the v2 path: a silently wrong-shaped
-                # leaf (model config drifted since the save) must not load
-                raise ValueError(
-                    f"checkpoint leaf {key!r} was saved with shape "
-                    f"{arr.shape} but the template wants {want} — model "
-                    f"configuration changed since the save")
-            new = jnp.asarray(arr, dtype=getattr(leaf, "dtype", None))
-        if shard is not None:
-            new = jax.device_put(new, shard)
-        leaves.append(new)
+            if shard is not None and not getattr(
+                    shard, "is_fully_addressable", True):
+                # place the raw KEY DATA (replicated; rank-agnostic spec)
+                # then reinterpret — device_put can't take the
+                # non-addressable sharding and the callback path can't
+                # carry the opaque key dtype
+                from jax.sharding import NamedSharding, PartitionSpec
+                data = _place(np.asarray(arr),
+                              NamedSharding(shard.mesh, PartitionSpec()))
+                new = jax.random.wrap_key_data(data)
+            else:
+                new = jax.random.wrap_key_data(jnp.asarray(arr))
+                if shard is not None:
+                    new = jax.device_put(new, shard)
+            leaves.append(new)
+            continue
+        want = _leaf_shape(leaf)
+        if want and arr.shape != want:
+            # same contract as the v2 path: a silently wrong-shaped
+            # leaf (model config drifted since the save) must not load
+            raise ValueError(
+                f"checkpoint leaf {key!r} was saved with shape "
+                f"{arr.shape} but the template wants {want} — model "
+                f"configuration changed since the save")
+        new = jnp.asarray(arr, dtype=getattr(leaf, "dtype", None))
+        leaves.append(_place(new, shard))
 
 
 def restore_params(path: str, params_template, shardings=None):

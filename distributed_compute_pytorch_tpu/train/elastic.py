@@ -27,9 +27,25 @@ subsystem: an injected crash or hang exercises the exact recovery path in
 tests, gated to the first incarnation via ``DCP_RESTART_COUNT`` so the
 restarted run proceeds cleanly.
 
-Multi-host note: preemption checkpoints and heartbeats are per-process;
-checkpoint.save() is a collective, so coordinated preemption (the cluster
-manager signalling every host, as GCE/TPU maintenance events do) is assumed.
+Multi-host (VERDICT r3 #6): both halves coordinate across hosts through a
+shared filesystem (GCS/NFS — standard on pods):
+
+- **Heartbeats**: each host writes ``{dir}/host-{i}.hb``
+  (``Heartbeat(dir, host_index=i)``); :meth:`Heartbeat.read` on a
+  DIRECTORY aggregates to the stalest host, so one supervisor (or
+  dashboard) watches the whole cluster and a single hung host reads as a
+  cluster hang.
+- **Coordinated preemption** (:class:`ClusterPreemption`): any host's
+  SIGTERM touches ``{dir}/requested``; the first host to OBSERVE it in
+  its train loop claims ``{dir}/stop-at`` (O_EXCL) containing
+  ``step + margin``. SPMD training is lockstep (every step runs
+  collectives), so "stop at global step S" is a decision every host can
+  execute identically — all hosts checkpoint at the SAME step and the
+  collective save stays consistent. Restart: every host's child exits
+  ``EXIT_PREEMPTED``; each host's supervisor restarts with ``--resume``
+  and the ``jax.distributed`` rendezvous re-forms. A host killed for a
+  hang breaks its peers' collectives; their crashes consume their own
+  supervisors' budgets and the cluster re-forms the same way.
 """
 
 from __future__ import annotations
@@ -106,9 +122,16 @@ class Heartbeat:
 
     ``beat()`` is cheap enough for the logging cadence (one tmpfile write +
     rename); readers (:func:`supervise`, dashboards) never see a torn file.
+
+    ``host_index``: multi-host mode — ``path`` is a shared DIRECTORY and
+    this host beats into ``host-{i}.hb``; :meth:`read` on the directory
+    aggregates to the STALEST host (one hung host == cluster hang).
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, host_index: int | None = None):
+        if host_index is not None:
+            os.makedirs(path, exist_ok=True)
+            path = os.path.join(path, f"host-{host_index}.hb")
         self.path = path
         d = os.path.dirname(os.path.abspath(path)) or "."
         os.makedirs(d, exist_ok=True)
@@ -122,6 +145,23 @@ class Heartbeat:
 
     @staticmethod
     def read(path: str) -> dict | None:
+        """One beat dict; for a DIRECTORY, the aggregate over ``host-*.hb``
+        with ``ts`` = the stalest host's (plus ``hosts``/``stalest``)."""
+        if os.path.isdir(path):
+            beats = {}
+            try:
+                names = sorted(os.listdir(path))
+            except OSError:
+                return None
+            for fn in names:
+                if fn.startswith("host-") and fn.endswith(".hb"):
+                    hb = Heartbeat.read(os.path.join(path, fn))
+                    if hb is not None:
+                        beats[fn] = hb
+            if not beats:
+                return None
+            stalest = min(beats, key=lambda k: beats[k]["ts"])
+            return dict(beats[stalest], hosts=len(beats), stalest=stalest)
         try:
             with open(path) as f:
                 return json.load(f)
@@ -130,9 +170,129 @@ class Heartbeat:
 
     @staticmethod
     def age(path: str) -> float | None:
-        """Seconds since the last beat, or None if no beat yet."""
+        """Seconds since the last beat (stalest host for a directory), or
+        None if no beat yet."""
         hb = Heartbeat.read(path)
         return None if hb is None else max(0.0, time.time() - hb["ts"])
+
+    @staticmethod
+    def clear_dir(path: str) -> None:
+        """Coordinator-only, at run start: drop ``host-*.hb`` files left by
+        the previous incarnation (possibly a DIFFERENT world size — elastic
+        resize). Without this, a dead host's old beat keeps the aggregate
+        permanently stale and the supervisor kill-loops a healthy resumed
+        run. Ordering: the cleanup happens in trainer ``__init__``, which
+        every host must complete before the first train step's collective,
+        and the first NEW beat only happens after that step — so no live
+        beat can be deleted."""
+        if not os.path.isdir(path):
+            return
+        for fn in os.listdir(path):
+            if fn.startswith("host-") and fn.endswith(".hb"):
+                try:
+                    os.unlink(os.path.join(path, fn))
+                except FileNotFoundError:
+                    pass
+
+
+class ClusterPreemption:
+    """Coordinated multi-host preemption over a shared directory.
+
+    Protocol (see module docstring): ``request()`` (from any host's signal
+    handler path) touches ``requested``; the first host that observes the
+    request in its train loop claims ``stop-at`` with O_EXCL, writing the
+    global step all hosts must stop AFTER (``observed_step + margin``).
+    Because SPMD keeps hosts lockstep in step count, every host reaches
+    exactly that step and the preemption checkpoint's collectives line up.
+
+    ``margin`` absorbs cross-host observation skew (shared-fs propagation
+    is well under one training step; the claim is also re-read every step,
+    so even a host that first learns of the stop from ``stop-at`` itself
+    has ``margin`` steps of slack).
+    """
+
+    REQUESTED = "requested"
+    STOP_AT = "stop-at"
+
+    def __init__(self, flag_dir: str, margin: int = 4):
+        self.dir = flag_dir
+        self.margin = margin
+        self._stop_step: int | None = None   # cache: immutable once set
+        os.makedirs(flag_dir, exist_ok=True)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def reset(self) -> None:
+        """Coordinator-only, at run start: a stale flag from the previous
+        incarnation must not stop the resumed run."""
+        self._stop_step = None
+        for name in (self.REQUESTED, self.STOP_AT):
+            try:
+                os.unlink(os.path.join(self.dir, name))
+            except FileNotFoundError:
+                pass
+
+    # -- producer side --------------------------------------------------
+
+    def request(self) -> None:
+        """Record that SOME host was signalled (idempotent)."""
+        p = os.path.join(self.dir, self.REQUESTED)
+        if not os.path.exists(p):
+            atomic_write(p, lambda f: f.write(b"1"))
+
+    # -- consumer side (train loop, every step) -------------------------
+
+    def stop_step(self) -> int | None:
+        if self._stop_step is not None:      # immutable once claimed
+            return self._stop_step
+        try:
+            with open(os.path.join(self.dir, self.STOP_AT)) as f:
+                self._stop_step = json.load(f)["stop_step"]
+        except (OSError, json.JSONDecodeError, KeyError):
+            return None
+        return self._stop_step
+
+    def _claim(self, target: int) -> int:
+        """Claim the stop step crash-atomically: the content is written to
+        a private tmp file first and ``os.link`` publishes it — ``stop-at``
+        either doesn't exist or holds complete JSON, even if the claimant
+        dies mid-claim (an O_EXCL create-then-write would leave an empty
+        file that wedges every host's ``stop_step()`` forever)."""
+        dst = os.path.join(self.dir, self.STOP_AT)
+        tmp = os.path.join(self.dir, f".claim-{os.getpid()}.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"stop_step": target}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, dst)                # atomic; EEXIST = lost race
+            return target
+        except FileExistsError:
+            s = self.stop_step()
+            return target if s is None else s
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+
+    def check(self, locally_preempted: bool, global_step: int) -> bool:
+        """Poll once per train step; True = checkpoint NOW (this step is
+        the agreed stop point). Steady-state cost is ONE shared-fs stat
+        (the ``requested`` marker); the claimed stop step is cached."""
+        if locally_preempted:
+            self.request()
+        if (self._stop_step is None
+                and not locally_preempted
+                and not os.path.exists(os.path.join(self.dir,
+                                                    self.REQUESTED))):
+            return False
+        s = self.stop_step()
+        if s is None:
+            # first observer claims; link/EEXIST settles races
+            s = self._claim(global_step + self.margin)
+            self._stop_step = s
+        return global_step >= s
 
 
 def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,

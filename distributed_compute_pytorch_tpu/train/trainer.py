@@ -24,7 +24,7 @@ import numpy as np
 
 from distributed_compute_pytorch_tpu.core.config import Config
 from distributed_compute_pytorch_tpu.core.mesh import (
-    initialize_distributed, make_mesh, dp_world_size)
+    initialize_distributed, is_coordinator, make_mesh, dp_world_size)
 from distributed_compute_pytorch_tpu.data.datasets import load_dataset
 from distributed_compute_pytorch_tpu.data.loader import (
     DeviceFeeder, StreamingDeviceFeeder)
@@ -32,7 +32,7 @@ from distributed_compute_pytorch_tpu.data.shards import ShardedFileDataset
 from distributed_compute_pytorch_tpu.models.registry import build_model
 from distributed_compute_pytorch_tpu.train import checkpoint
 from distributed_compute_pytorch_tpu.train.elastic import (
-    Heartbeat, Preempted, PreemptionGuard, restart_count)
+    ClusterPreemption, Heartbeat, Preempted, PreemptionGuard, restart_count)
 from distributed_compute_pytorch_tpu.train.optim import build_optimizer
 from distributed_compute_pytorch_tpu.train.step import make_step_fns
 from distributed_compute_pytorch_tpu.utils.logging import MetricLogger, log0
@@ -176,8 +176,21 @@ class Trainer:
                                   mstate, self.state.model_state)
             self.state = self.state.replace(params=params, model_state=mstate)
             log0(f"imported torch checkpoint {config.import_torch}")
-        self.heartbeat = (Heartbeat(config.heartbeat_path)
+        multi_host = jax.process_count() > 1
+        if config.heartbeat_path and multi_host and is_coordinator():
+            # previous-incarnation beats (possibly from a LARGER world —
+            # elastic resize) would keep the aggregate permanently stale
+            Heartbeat.clear_dir(config.heartbeat_path)
+        self.heartbeat = (Heartbeat(config.heartbeat_path,
+                                    host_index=(jax.process_index()
+                                                if multi_host else None))
                           if config.heartbeat_path else None)
+        self.cluster = (ClusterPreemption(config.preempt_flag)
+                        if config.preempt_flag else None)
+        if self.cluster is not None and is_coordinator():
+            # a stale stop flag from the previous incarnation must not
+            # stop the resumed run
+            self.cluster.reset()
         self.checkpointer = (checkpoint.AsyncCheckpointer(
             sharded=config.ckpt_sharded) if config.async_checkpoint else None)
 
@@ -231,6 +244,9 @@ class Trainer:
         if (cfg.model in ("bert", "gpt2", "llama", "moe")
                 and cfg.virtual_stages > 1):
             kw["virtual_stages"] = cfg.virtual_stages
+        if (cfg.model in ("bert", "gpt2", "llama", "moe")
+                and cfg.num_layers is not None):
+            kw["num_layers"] = cfg.num_layers
         if cfg.seq_shard_activations:
             if cfg.model in ("bert", "gpt2", "llama"):
                 kw["seq_shard_activations"] = True
@@ -296,7 +312,7 @@ class Trainer:
                                        float(metrics["loss"]))
                 if self.heartbeat is not None:
                     self.heartbeat.beat(epoch, epoch * steps + b)
-            if guard is not None and guard.preempted:
+            if self._should_preempt(guard, epoch * steps + b):
                 self._save_ckpt(epoch, extra={"step_in_epoch": b + 1})
                 log0(f"preempted at epoch {epoch} step {b}; "
                      f"checkpoint written to {cfg.ckpt_path}")
@@ -312,6 +328,18 @@ class Trainer:
             np.asarray(metrics["loss"])
         secs = timer.elapsed()
         return (steps - skip) * cfg.batch_size / secs
+
+    def _should_preempt(self, guard, global_step: int) -> bool:
+        """Per-step preemption poll. Single-host: the local signal flag.
+        Multi-host (``--preempt_flag`` on a shared fs): the coordinated
+        protocol — ALL hosts stop at the same agreed global step, so the
+        preemption checkpoint's collectives line up (elastic.py
+        ``ClusterPreemption``)."""
+        if guard is None:
+            return False
+        if self.cluster is not None:
+            return self.cluster.check(guard.preempted, global_step)
+        return guard.preempted
 
     def _maybe_inject_fault(self, global_step: int) -> None:
         """Fault injection for exercising the recovery path (elastic.py):
@@ -354,7 +382,14 @@ class Trainer:
                 self.eval_feed.epoch(0, with_valid=True)):
             if self.heartbeat is not None and b % self.config.log_every == 0:
                 self.heartbeat.beat(epoch, b)   # stay live through eval
-            if guard is not None and guard.preempted:
+            if guard is not None and guard.preempted and self.cluster:
+                # multi-host: a mid-eval exit cannot be coordinated (hosts
+                # would leave the eval collectives at different batches) —
+                # record the request; the stop is honoured at the next
+                # train-step boundary, where steps are globally lockstep
+                self.cluster.request()
+            if (guard is not None and guard.preempted
+                    and self.cluster is None):
                 # train state is unchanged during eval, so checkpointing the
                 # finished epoch now (rather than after the full eval pass +
                 # epoch save) keeps us inside a short preemption grace
@@ -422,7 +457,14 @@ class Trainer:
                     return {"preempted": True, "epoch": epoch}
                 self.logger.epoch_time(epoch, timer.elapsed(), throughput)
                 self._save_ckpt(epoch, extra={"eval_done": True})
-                if guard.preempted:
+                if guard.preempted and self.cluster is not None:
+                    # multi-host: record the request and keep going — the
+                    # NEXT epoch's first train steps coordinate the stop
+                    # (a unilateral exit here would leave the other hosts
+                    # hanging in their next collective). A last-epoch
+                    # signal simply lets the run complete.
+                    self.cluster.request()
+                elif guard.preempted:
                     # signal arrived after eval (eval-time signals raise
                     # Preempted inside evaluate()): during the epoch-time
                     # print or the epoch-end save. The checkpoint just

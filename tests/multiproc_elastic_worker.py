@@ -1,0 +1,61 @@
+"""Worker for tests/test_multiprocess.py::test_coordinated_preemption —
+NOT a pytest file.
+
+Runs the REAL Trainer in a 2-process ``jax.distributed`` world with
+``--preempt_flag`` coordination: the test SIGTERMs only process 0; BOTH
+processes must checkpoint at the same agreed step and exit
+``EXIT_PREEMPTED``; a relaunch with ``resume`` completes the run.
+
+Usage: python multiproc_elastic_worker.py <pid> <nprocs> <port> <out_dir>
+       <phase: run|resume>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    out_dir, phase = sys.argv[4], sys.argv[5]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        initialize_distributed)
+    initialize_distributed(f"localhost:{port}", nprocs, pid)
+    assert jax.process_count() == nprocs
+
+    from distributed_compute_pytorch_tpu.data.datasets import (
+        synthetic_images)
+    from distributed_compute_pytorch_tpu.train.elastic import EXIT_PREEMPTED
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    # phase "full": an UNINTERRUPTED 2-process run of the same config into
+    # its own checkpoint — the bit-exactness reference for the
+    # preempt+resume pair (a single-process run differs at float-sum
+    # ordering across the process boundary)
+    ck = "full.npz" if phase == "full" else "ck.npz"
+    cfg = Config(
+        batch_size=32, lr=0.5, gamma=0.7, epochs=2, mesh="data=8",
+        model="convnet", dataset="synthetic-images", optimizer="adadelta",
+        log_every=1, seed=0,
+        ckpt_path=os.path.join(out_dir, ck),
+        heartbeat_path=os.path.join(out_dir, "hb"),
+        preempt_flag=(None if phase == "full"
+                      else os.path.join(out_dir, "flag")),
+        resume=(phase == "resume"),
+    )
+    data = synthetic_images(512, (28, 28, 1), 10, seed=0)
+    eval_data = synthetic_images(128, (28, 28, 1), 10, seed=1)
+    result = Trainer(cfg, train_data=data, eval_data=eval_data).fit()
+
+    print(f"WORKER_DONE pid={pid} result={result}", flush=True)
+    sys.exit(EXIT_PREEMPTED if result.get("preempted") else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Multi-host elastic coordination (VERDICT r3 #6): per-host heartbeat
+aggregation and the coordinated-preemption stop-step protocol — unit
+level here; the 2-OS-process integration lives in
+tests/test_multiprocess.py::test_coordinated_preemption_two_process."""
+
+import json
+import os
+import time
+
+import pytest
+
+from distributed_compute_pytorch_tpu.train.elastic import (
+    ClusterPreemption, Heartbeat)
+
+
+def test_heartbeat_directory_aggregates_to_stalest(tmp_path):
+    d = str(tmp_path / "hb")
+    h0 = Heartbeat(d, host_index=0)
+    h1 = Heartbeat(d, host_index=1)
+    h0.beat(epoch=3, step=30)
+    time.sleep(0.05)
+    h1.beat(epoch=3, step=31)
+
+    agg = Heartbeat.read(d)
+    assert agg["hosts"] == 2
+    assert agg["stalest"] == "host-0.hb"
+    assert agg["step"] == 30            # the stalest host's beat
+    # age reflects the STALEST host (one hung host == cluster hang)
+    a = Heartbeat.age(d)
+    assert a is not None and a >= Heartbeat.read(
+        os.path.join(d, "host-1.hb"))["ts"] - Heartbeat.read(
+        os.path.join(d, "host-0.hb"))["ts"]
+
+
+def test_heartbeat_empty_directory_reads_none(tmp_path):
+    d = str(tmp_path / "hb2")
+    os.makedirs(d)
+    assert Heartbeat.read(d) is None
+    assert Heartbeat.age(d) is None
+
+
+def test_cluster_preemption_agrees_on_stop_step(tmp_path):
+    """Two hosts polling the shared dir stop at the SAME step: the first
+    observer claims stop-at = observed_step + margin; the other adopts
+    it."""
+    d = str(tmp_path / "flag")
+    a = ClusterPreemption(d, margin=3)
+    b = ClusterPreemption(d, margin=3)
+
+    # nobody signalled: no stops
+    assert not a.check(False, 10) and not b.check(False, 10)
+
+    # host A gets SIGTERM at step 10 -> request + claim stop at 13
+    assert not a.check(True, 10)
+    assert a.stop_step() == 13
+    # host B (never signalled) adopts the same stop step
+    assert not b.check(False, 11)
+    assert not b.check(False, 12)
+    assert b.check(False, 13)
+    assert a.check(True, 13)
+    # a second signal on B must NOT move the agreed step
+    assert b.check(True, 13)
+    assert b.stop_step() == 13
+
+
+def test_cluster_preemption_claim_race_single_winner(tmp_path):
+    """Both hosts observe the request on the same step: O_EXCL lets only
+    one claim; both read the same stop step."""
+    d = str(tmp_path / "flag2")
+    a = ClusterPreemption(d, margin=2)
+    b = ClusterPreemption(d, margin=2)
+    a.request()
+    assert not a.check(False, 5)
+    assert not b.check(False, 5)
+    assert a.stop_step() == b.stop_step() == 7
+
+
+def test_cluster_preemption_reset_clears_stale_flags(tmp_path):
+    d = str(tmp_path / "flag3")
+    a = ClusterPreemption(d, margin=2)
+    a.check(True, 4)
+    assert a.stop_step() is not None
+    a.reset()
+    assert a.stop_step() is None
+    assert not a.check(False, 100)

@@ -46,7 +46,10 @@ def test_moe_capacity_drops_overflow():
 def test_moe_identical_experts_match_dense_ffn():
     """With every expert identical and capacity ample, the MoE output must
     equal a single dense FFN — routing becomes irrelevant."""
-    layer = MoELayer(d_model=16, d_ff=32, num_experts=4, capacity_factor=8.0)
+    # classic argmax selection: the identical-experts identity depends on
+    # the gate being the TOP prob (sinkhorn may select a lower-prob expert)
+    layer = MoELayer(d_model=16, d_ff=32, num_experts=4, capacity_factor=8.0,
+                     router_balance="aux")
     params = layer.init(jax.random.key(0))
     # clone expert 0 into all experts
     for k in ("w_in", "b_in", "w_out", "b_out"):
@@ -163,8 +166,10 @@ def test_top2_uses_two_distinct_experts_per_token():
     """With ample capacity every token must occupy exactly one queue slot
     in each of its TWO DISTINCT top experts, with renormalised gates
     summing to 1 — checked against an independently computed routing."""
+    # classic argmax selection (the independent reference routes by the
+    # two HIGHEST probs; sinkhorn deliberately deviates to balance load)
     layer = MoELayer(d_model=16, d_ff=32, num_experts=4, capacity_factor=8.0,
-                     top_k=2)
+                     top_k=2, router_balance="aux")
     params = layer.init(jax.random.key(2))
     x = jax.random.normal(jax.random.key(3), (1, 16, 16))
     _, aux = layer.apply(params, x)
@@ -216,7 +221,10 @@ def test_grouped_routing_bounds_dispatch_memory():
 def test_grouped_routing_matches_global_when_capacity_ample():
     """With capacity far above demand nothing is ever dropped, so group
     boundaries are invisible: grouped == global routing bit-for-bit."""
-    common = dict(d_model=16, d_ff=32, num_experts=4, capacity_factor=16.0)
+    # classic argmax selection: sinkhorn's group-wise marginals make
+    # grouped vs global selections legitimately differ
+    common = dict(d_model=16, d_ff=32, num_experts=4, capacity_factor=16.0,
+                  router_balance="aux")
     lg = MoELayer(group_size=32, **common)
     lglobal = MoELayer(group_size=None, **common)
     params = lg.init(jax.random.key(4))
@@ -315,3 +323,76 @@ def test_moe_pipeline_matches_dp(devices8):
     # stage dim genuinely sharded: 2 layers / pipe=2 -> 1 per device
     w_in = state.params["blocks"]["moe"]["w_in"]
     assert w_in.sharding.shard_shape(w_in.shape)[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# Sinkhorn-balanced selection (VERDICT r3 #3: dropped tokens at low capacity)
+# ---------------------------------------------------------------------------
+
+
+def test_sinkhorn_selection_cuts_drops():
+    """At tight capacity the balanced selection drops far fewer tokens
+    than raw argmax — the whole point (measured ~0 vs 7-13% on bench
+    shapes)."""
+    common = dict(d_model=16, d_ff=32, num_experts=4, capacity_factor=1.25,
+                  top_k=2, group_size=64)
+    aux_layer = MoELayer(router_balance="aux", **common)
+    sk_layer = MoELayer(router_balance="sinkhorn", **common)
+    params = aux_layer.init(jax.random.key(0))
+    # skewed inputs: bias the router toward one expert so raw argmax
+    # overflows it
+    x = jax.random.normal(jax.random.key(1), (4, 64, 16))
+    x = x + 0.5 * params["router"]["kernel"][:, 0]
+
+    _, a = aux_layer.apply(params, x)
+    _, s = sk_layer.apply(params, x)
+    assert float(s["dropped_fraction"]) < 0.02, float(s["dropped_fraction"])
+    assert float(s["dropped_fraction"]) < float(a["dropped_fraction"])
+
+
+def test_sinkhorn_gates_differentiable():
+    """Selection is stop-gradiented; the GATES (raw probs of the chosen
+    experts) still carry gradient to the router kernel."""
+    layer = MoELayer(d_model=16, d_ff=32, num_experts=4, top_k=2,
+                     router_balance="sinkhorn")
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 16, 16))
+
+    def loss(p):
+        y, _ = layer.apply(p, x)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["router"]["kernel"]).sum()) > 0.0
+
+
+def test_sinkhorn_top2_distinct_experts_and_gate_norm():
+    """Structural invariants that survive balancing: each token's two
+    slots go to DISTINCT experts and the renormalised gates sum to 1."""
+    layer = MoELayer(d_model=16, d_ff=32, num_experts=4, capacity_factor=8.0,
+                     top_k=2, router_balance="sinkhorn")
+    params = layer.init(jax.random.key(2))
+    x = jax.random.normal(jax.random.key(3), (1, 16, 16))
+    # identical experts returning constant 1 -> y = sum of gates
+    for k in ("w_in", "w_out"):
+        params[k] = jnp.zeros_like(params[k])
+    params["b_in"] = jnp.zeros_like(params["b_in"])
+    params["b_out"] = jnp.ones_like(params["b_out"])
+    y, aux = layer.apply(params, x)
+    assert float(aux["dropped_fraction"]) == 0.0
+    np.testing.assert_allclose(np.asarray(y), 1.0, rtol=1e-5)
+
+
+def test_sinkhorn_rejects_top1():
+    """top-1's unnormalised gate would scale balanced-away tokens by ~0
+    (an uncounted drop) — explicit sinkhorn+top_k=1 must raise; 'auto'
+    resolves to classic argmax there."""
+    layer = MoELayer(d_model=16, d_ff=32, num_experts=4, top_k=1,
+                     router_balance="sinkhorn")
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 16, 16))
+    with pytest.raises(ValueError, match="top_k=2"):
+        layer.apply(params, x)
+    auto = MoELayer(d_model=16, d_ff=32, num_experts=4, top_k=1)
+    y, aux = auto.apply(auto.init(jax.random.key(0)), x)
+    assert np.isfinite(np.asarray(y)).all()

