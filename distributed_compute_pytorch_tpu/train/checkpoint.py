@@ -512,8 +512,15 @@ def _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
                 f"checkpoint leaf {key!r} was saved with shape "
                 f"{arr.shape} but the template wants {want} — model "
                 f"configuration changed since the save")
-        new = jnp.asarray(arr, dtype=getattr(leaf, "dtype", None))
-        leaves.append(_place(new, shard))
+        dtype = getattr(leaf, "dtype", None)
+        if shard is not None and not getattr(shard, "is_fully_addressable",
+                                             True):
+            # multi-process: cast HOST-side and let make_array_from_callback
+            # slice it — jnp.asarray first would round-trip the full global
+            # leaf through local device 0 (transient full-leaf HBM spike)
+            leaves.append(_place(np.asarray(arr, dtype=dtype), shard))
+        else:
+            leaves.append(_place(jnp.asarray(arr, dtype=dtype), shard))
 
 
 def restore_params(path: str, params_template, shardings=None):

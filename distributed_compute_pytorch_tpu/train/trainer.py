@@ -187,10 +187,18 @@ class Trainer:
                           if config.heartbeat_path else None)
         self.cluster = (ClusterPreemption(config.preempt_flag)
                         if config.preempt_flag else None)
-        if self.cluster is not None and is_coordinator():
-            # a stale stop flag from the previous incarnation must not
-            # stop the resumed run
-            self.cluster.reset()
+        if self.cluster is not None:
+            if is_coordinator():
+                # a stale stop flag from the previous incarnation must not
+                # stop the resumed run
+                self.cluster.reset()
+            if multi_host:
+                # BARRIER the reset: jax dispatch is async, so without it
+                # a non-coordinator's first host-side poll can read the
+                # stale flags before the coordinator deletes them (the
+                # train-step collective does NOT order host code)
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("dcp:preempt-reset")
         self.checkpointer = (checkpoint.AsyncCheckpointer(
             sharded=config.ckpt_sharded) if config.async_checkpoint else None)
 
