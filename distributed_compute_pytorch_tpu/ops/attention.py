@@ -138,6 +138,11 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
     if grouped:
         assert q_len == 1, "GQA cache read expects single-position queries"
         q = q.reshape(B, hk, (H // hk) * q_len, hd)
+    # NOTE (measured v5e, 2026-07-30): padding the 1-row query up to a
+    # sublane tile speeds the ISOLATED cache read (0.611 -> 0.466 ms for
+    # 12 MHA layers) but REGRESSES the full decode tick (gpt2 1.07 ->
+    # 1.14 ms; the 8x f32 score intermediates break fusion elsewhere) —
+    # measured and rejected, don't re-add without end-to-end numbers.
     valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, None, None, :]
     if slot_mask is not None:
         valid = jnp.logical_and(valid,

@@ -336,10 +336,14 @@ def test_supervisor_kills_and_restarts_hung_child(tmp_path):
     """An injected hang (stuck-collective stand-in) must be detected via the
     stale heartbeat, the child killed, and the restarted run complete."""
     hb = str(tmp_path / "hb.json")
+    # 45s staleness window: the trainer beats every step (log_every 1),
+    # so a REAL hang is still detected quickly, while a loaded CI box
+    # that stalls a healthy child between beats for >10s no longer
+    # false-kills it (the round-3-documented flake mode)
     cmd = _cli_cmd(tmp_path, "--supervise", "--max_restarts", "2",
                    "--checkpoint_every", "2", "--fault_at_step", "4",
                    "--fault_mode", "hang", "--heartbeat_path", hb,
-                   "--heartbeat_timeout", "10")
+                   "--heartbeat_timeout", "45")
     proc = subprocess.run(cmd, env=_env(), cwd=REPO, capture_output=True,
                           text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -441,19 +445,22 @@ def test_supervise_first_beat_timeout_tolerates_slow_start(tmp_path):
     fresh beat, not keep counting)."""
     hb = tmp_path / "hb.json"
     script = tmp_path / "slow_start.py"
-    # margins sized for a loaded CI box: the pre-beat 'compile' sleep is
-    # tiny next to the window (interpreter startup under load has been
-    # observed to eat multiple seconds), and outliving the window is
-    # measured from child start (0.3 + 11.0 > 10.0)
+    # timing-robust shape (round-3 flake writeup): the child beats as soon
+    # as it starts (a 20s window would need 20s of interpreter startup to
+    # false-kill), then outlives the window measured from its OWN clock —
+    # a monotonic loop, not a fixed sleep, so host load can only stretch
+    # it further past the window, never under
     script.write_text(
         "import json, sys, time\n"
+        "t0 = time.monotonic()\n"
         "time.sleep(0.3)\n"                      # 'compile', inside window
         f"json.dump({{'ts': time.time(), 'epoch': 0, 'step': 0}}, "
         f"open({str(hb)!r}, 'w'))\n"
-        "time.sleep(11.0)\n"                     # outlive the 10s window
+        "while time.monotonic() - t0 < 21.0:\n"  # outlive the 20s window
+        "    time.sleep(0.2)\n"
         "sys.exit(0)\n")
     rc = supervise([str(script)], max_restarts=0, heartbeat_path=str(hb),
-                   heartbeat_timeout=600.0, first_beat_timeout=10.0,
+                   heartbeat_timeout=600.0, first_beat_timeout=20.0,
                    poll_interval=0.05)
     assert rc == 0
 
