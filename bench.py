@@ -370,7 +370,8 @@ def _bench_bert(jax, jnp, np, mesh, n_chips, peak_flops):
     }
 
 
-def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops):
+def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops,
+               dispatch_mode="einsum", remat="dots"):
     """Switch/GShard MoE rung: GPT-2-small-geometry blocks with an 8-expert
     top-2 grouped-routing MoE MLP, bf16 train step. Surfaces the
     dropped-token fraction (VERDICT r2 #8) alongside throughput."""
@@ -381,8 +382,13 @@ def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops):
     from distributed_compute_pytorch_tpu.train.step import make_step_fns
 
     B, T = 8 * n_chips, 1024
-    # remat: the 8-expert model is ~453M params; without it the step's
-    # activations overflow a single v5e's 16G HBM at B=8.
+    # remat="dots": the 8-expert model is ~453M params; with remat OFF the
+    # step's activations overflow a single v5e's 16G HBM at B=8 (measured:
+    # 19.7G needed), but FULL per-block remat re-runs every expert matmul
+    # in the backward. Selective remat saves the named matmul outputs
+    # (~150 MB/layer) and recomputes only routing/gelu — measured r4 on
+    # v5e: 144.4 ms (block remat+scan) -> 134.6 (dots+scan) -> 118.2
+    # (dots+unrolled layers), active-MFU 0.346 -> 0.422.
     # group 512 measured best on v5e (2026-07-30 sweep): 158 ms vs 169 at
     # 1024, 182 at 2048, 261 global — smaller [G, E, C] dispatch tensors
     # beat fewer-larger groups until capacity granularity bites.
@@ -392,13 +398,20 @@ def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops):
     # buys drop reduction ONLY by burning active-MFU. Balancing the
     # SELECTION instead (models/moe.py router_balance) collapses drops
     # without the padding: measured 2.1%/0.342 at cf=1.0, 0.0%/0.317 at
-    # cf=1.25. The residual gap to ~0.38 active-MFU is the dispatch/
-    # combine einsums' non-expert FLOPs (~25% of expert compute at C=128)
-    # plus remat — inherent to the einsum (GShard) formulation at this
-    # scale; a sort-based dispatch is the known next step up.
+    # cf=1.25. The once-suspected "next step up" — gather-based dispatch
+    # replacing the one-hot einsums (models/moe.py dispatch_mode="gather")
+    # — was implemented and measured-REJECTED: the row gathers XLA emits
+    # run ~7x slower than the dispatch einsum's MXU one-hot matmuls
+    # (5.6 vs 0.8 ms/layer fwd), and the full rung drops 144 -> 164 ms.
+    # What actually closed the gap was the backward: full block remat was
+    # re-running every expert matmul; remat="dots" + unrolled layers
+    # measured 144.4 -> 118.2 ms (active-MFU 0.346 -> 0.422). The
+    # remaining gap to ~0.5 is the dispatch/combine einsums' non-expert
+    # FLOPs (~17%) and the routing recompute (saving the one-hots too
+    # measured flat, 119.7 — not worth 0.8 GB).
     cfg = MoETransformerConfig(num_experts=8, top_k=2, moe_group_size=512,
                                capacity_factor=1.0, dropout_rate=0.0,
-                               remat=True)
+                               remat=remat, dispatch_mode=dispatch_mode)
     model = MoETransformerLM(cfg)
     tx = build_optimizer("adamw", lr=3e-4, gamma=1.0, steps_per_epoch=100,
                          warmup_steps=10, total_steps=1000)
@@ -540,20 +553,30 @@ def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2"):
         int(np.asarray(gen(params, prompt))[0, -1])   # compile + warm
         runs[n] = gen
 
+    # K back-to-back generate calls per timed wall, one fetch at the end
+    # (the device executes submitted programs in order, so the single
+    # fetch forces all K). Rationale (r4 reconciliation): a single
+    # wall(256)-wall(128) diff is ~65 ms of device time against the
+    # relay's +-20-25 ms per-call jitter — at that SNR the min-of-repeats
+    # estimator can land anywhere in 0.26-0.81 ms/tick, including BELOW
+    # the 0.40 ms HBM floor (measured r4: llama 0.257/0.504/0.793/0.808
+    # across process restarts — the first is physically impossible, so
+    # the estimator, not the device, was moving). With K=8 the diff
+    # carries ~8x the device signal while per-call dispatch overhead
+    # appears K times in BOTH walls and still cancels.
+    K = 8
+
     def time_n(n):
+        gen = runs[n // K]     # n is K*(generated tokens); KeyError on
+                               # any probe length the runs dict lacks
         t0 = time.perf_counter()
-        out = runs[n](params, prompt)
+        out = None
+        for _ in range(K):
+            out = gen(params, prompt)
         np.asarray(out[0, -1])
         return time.perf_counter() - t0
 
-    # n = generated-token count: wall(256) - wall(128) over the extra 128
-    # ticks, with _two_length_dt's shared jitter guard. repeats=5: the
-    # short-length wall jitters by +-20% on the relay (reconciliation
-    # probe 2026-07-30: gpt2 w128 206-263 ms, w256 stable ~384), and the
-    # min over 5 is what made llama reproducible at ~0.51 ms across
-    # process restarts (the r3 driver-vs-committed 34% discrepancy was
-    # this jitter at repeats=3)
-    per_tok = _two_length_dt(time_n, 128, repeats=5)
+    per_tok = _two_length_dt(time_n, K * 128, repeats=5)
 
     # HBM byte model per tick: all params (bf16) + the k+v cache window
     # the masked attention reads (t_max slots, kv-head width, all layers)

@@ -1,0 +1,44 @@
+"""A/B the MoE dispatch formulations on the real chip (bench shapes).
+
+Runs `bench.py::_bench_moe` — the exact committed rung (8-expert top-2,
+GPT-2-small geometry, B=8 T=1024, bf16, remat="dots", Sinkhorn
+selection, group 512, capacity_factor 1.0) — once per `dispatch_mode`,
+so the default in `models/moe.py` is a measured choice, not a guess.
+One source of truth: the rung's config lives in `_bench_moe`; this
+script only varies the arguments it exposes.
+
+Measured 2026-07-31 (v5e, remat="dots"+unroll): einsum 118 ms /
+0.422 active-MFU, gather ~164 ms — the row gathers XLA emits lose ~7x
+to the dispatch einsum's MXU one-hot matmuls.
+
+Usage:  python benchmarks/ablate_moe_dispatch.py [einsum gather]
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from bench import _PEAK_BF16, _bench_moe  # noqa: E402
+
+
+def run(mode: str, remat="dots"):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    peak = _PEAK_BF16.get(devices[0].device_kind)
+    mesh = make_mesh("data=-1", devices=devices)
+    r = _bench_moe(jax, jnp, np, mesh, n_chips, peak,
+                   dispatch_mode=mode, remat=remat)
+    print(f"{mode:8s} step_ms={r['step_ms']:8.2f}  "
+          f"tok/s/chip={r['tokens_per_sec_per_chip']:9.1f}  "
+          f"active_mfu={r['mfu_active']}  finite={r['loss_finite']}")
+
+
+if __name__ == "__main__":
+    for mode in (sys.argv[1:] or ["einsum", "gather"]):
+        run(mode)

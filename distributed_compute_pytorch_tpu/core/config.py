@@ -113,9 +113,11 @@ class Config:
     # rematerialise transformer blocks on backward (jax.checkpoint): one
     # extra forward buys ~2-4x batch when HBM binds
     remat: bool = False
-    # remat granularity: 'block' (each transformer block), or 'stage' (each
-    # pipeline-stage tick — the 1F1B memory profile; needs a pipe>1 mesh,
-    # see parallel/pipeline.py)
+    # remat granularity: 'block' (each transformer block), 'dots' (save
+    # the named matmul outputs, recompute only elementwise work — less
+    # memory saved, no matmul runs twice), or 'stage' (each pipeline-stage
+    # tick — the 1F1B memory profile; needs a pipe>1 mesh, see
+    # parallel/pipeline.py)
     remat_mode: str = "block"
     # device-side train-time image augmentation (ops/augment.py), traced
     # into the jitted step: none | flip | flip-crop
@@ -238,8 +240,9 @@ class Config:
         p.add_argument("--compute_dtype", type=str, default=cls.compute_dtype)
         p.add_argument("--param_dtype", type=str, default=cls.param_dtype)
         p.add_argument("--remat_mode", type=str, default=cls.remat_mode,
-                       choices=("block", "stage"),
-                       help="remat granularity: per-block, or per-pipeline-"
+                       choices=("block", "dots", "stage"),
+                       help="remat granularity: per-block, selective "
+                            "(save matmul outputs only), or per-pipeline-"
                             "stage (1F1B memory profile; pipe meshes only)")
         p.add_argument("--remat", action="store_true",
                        help="rematerialise transformer blocks on backward "

@@ -109,26 +109,42 @@ class LlamaBlock:
         return pos
 
     def _qkv(self, params, h, positions):
-        """Projected + roped q/k/v (K/V at GQA kv-head width)."""
+        """Projected + roped q/k/v (K/V at GQA kv-head width).
+
+        The three projection outputs carry the "qkv" checkpoint tag
+        (pre-rope — rope is elementwise and cheap to recompute), so
+        ``remat="dots"`` re-runs no projection matmul in the backward,
+        matching the transformer.py attention sublayer."""
+        from jax.ad_checkpoint import checkpoint_name
         c = self.config
         d, hd = c.d_model, c.head_dim
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
-        q = A.split_heads(dense(d, c.num_heads * hd).apply(params["q"], h),
-                          c.num_heads)
-        k = A.split_heads(dense(d, c.num_kv_heads * hd).apply(params["k"], h),
-                          c.num_kv_heads)
-        v = A.split_heads(dense(d, c.num_kv_heads * hd).apply(params["v"], h),
-                          c.num_kv_heads)
+        q = A.split_heads(checkpoint_name(
+            dense(d, c.num_heads * hd).apply(params["q"], h), "qkv"),
+            c.num_heads)
+        k = A.split_heads(checkpoint_name(
+            dense(d, c.num_kv_heads * hd).apply(params["k"], h), "qkv"),
+            c.num_kv_heads)
+        v = A.split_heads(checkpoint_name(
+            dense(d, c.num_kv_heads * hd).apply(params["v"], h), "qkv"),
+            c.num_kv_heads)
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
         return q, k, v
 
     def _mlp(self, params, x):
+        from jax.ad_checkpoint import checkpoint_name
         c = self.config
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
         h = L.RMSNorm(c.d_model, c.rms_eps).apply(params["mlp_norm"], x)
-        gated = (jax.nn.silu(dense(c.d_model, c.d_ff).apply(params["gate"], h))
-                 * dense(c.d_model, c.d_ff).apply(params["up"], h))
+        # both d->d_ff projections saved under remat="dots" (the product
+        # alone would not do: silu' needs gate_out and the gate grad needs
+        # up_out, so saving only silu(gate)*up still re-runs both matmuls)
+        gate_out = checkpoint_name(
+            dense(c.d_model, c.d_ff).apply(params["gate"], h), "mlp_pre")
+        up_out = checkpoint_name(
+            dense(c.d_model, c.d_ff).apply(params["up"], h), "mlp_pre")
+        gated = jax.nn.silu(gate_out) * up_out
         return x + dense(c.d_ff, c.d_model).apply(params["down"], gated)
 
     def _ssa(self, x, manual_axes):
@@ -162,6 +178,8 @@ class LlamaBlock:
         # K/V — see dispatch_attention)
         o = dispatch_attention(q, k, v, causal=True, kv_mask=kv_mask,
                                manual_axes=manual_axes)
+        from jax.ad_checkpoint import checkpoint_name
+        o = checkpoint_name(o, "attn_ctx")   # saved under remat="dots"
         x = x + dense(c.num_heads * hd, d).apply(params["o"],
                                                  A.merge_heads(o))
         return self._mlp(params, self._ssa(x, manual_axes))

@@ -76,6 +76,21 @@ class MoELayer:
     # prob — an uncounted drop — so sinkhorn+top_k=1 is rejected.
     router_balance: str = "auto"
     sinkhorn_iters: int = 3
+    # "einsum": GShard one-hot contractions — dispatch/combine are
+    # [G,Ng,E,C] matmuls (2*N*E*C*d extra MACs, ~17% of expert compute at
+    # the bench shapes). "gather": same routing decisions expressed as row
+    # gathers — the queue position already names each token's slot, so
+    # dispatch is take_along_axis into [G,E*C,d] (sentinel -> a zero row
+    # for unfilled slots / dropped tokens) and combine gathers each
+    # token's expert output back and scales by the gate. Identical math
+    # (one-hot contractions pick exactly one row), no contraction FLOPs;
+    # both paths are differentiable (gather's transpose is scatter-add).
+    # MEASURED (v5e, bench shapes, r4): einsum wins decisively — XLA's
+    # row gathers run ~7x slower than the one-hot matmuls the MXU eats
+    # (5.6 vs 0.8 ms/layer fwd; full rung 164 vs 144 ms) — so einsum
+    # stays the default; "gather" is kept as the measured-rejected
+    # alternative (it may win on backends with fast gathers).
+    dispatch_mode: str = "einsum"
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -95,6 +110,39 @@ class MoELayer:
         c = int(self.capacity_factor * self.top_k * group_tokens
                 / self.num_experts)
         return max(c, 1)
+
+    def _dispatch_gather(self, xg, slots, C):
+        """Routing decisions -> row gathers (no one-hot contractions).
+
+        Each (token, slot) has a flat destination ``e*C + queue_pos``;
+        dropped tokens go to a trash column past the real slots. A scatter
+        of token indices inverts that map into ``src [G, E*C]`` (sentinel
+        ``Ng`` -> an appended zero row, so unfilled capacity slots read
+        zeros exactly like the einsum dispatch), and dispatch is one
+        ``take_along_axis``. Returns the dispatched ``[G, E, C, d]`` block
+        plus per-slot ``(dst, gate)`` for the combine-side gather. Queue
+        positions are collision-free across slots (slot 2 starts after
+        slot 1's per-expert assignment count), so one table serves both.
+        """
+        G, Ng, d = xg.shape
+        E = self.num_experts
+        tok = jnp.broadcast_to(
+            jnp.arange(Ng, dtype=jnp.int32)[None], (G, Ng))
+        g_idx = jnp.arange(G, dtype=jnp.int32)[:, None]
+        src = jnp.full((G, E * C + 1), Ng, jnp.int32)
+        picks = []
+        for oh, keep, pos, gate in slots:
+            e_n = jnp.argmax(oh, -1).astype(jnp.int32)          # [G, Ng]
+            p_n = pos.sum(-1).astype(jnp.int32)                 # [G, Ng]
+            kept = keep.sum(-1) > 0                             # [G, Ng]
+            dst = jnp.where(kept, e_n * C + p_n, E * C)
+            src = src.at[g_idx, dst].set(tok, mode="drop")
+            picks.append((dst, gate * kept))
+        xpad = jnp.concatenate(
+            [xg, jnp.zeros((G, 1, d), xg.dtype)], axis=1)
+        xdisp = jnp.take_along_axis(
+            xpad, src[:, :E * C, None], axis=1)                 # [G, E*C, d]
+        return xdisp.reshape(G, E, C, d), picks
 
     def apply(self, params, x):
         """``x [B, T, d]`` -> ``(y [B, T, d], aux)`` where ``aux`` carries
@@ -162,46 +210,71 @@ class MoELayer:
             return oh, pos, keep, gate
 
         oh1, pos1, keep1, gate1 = slot(sel, jnp.zeros((G, E), jnp.float32))
-        slots = [(keep1, pos1, gate1)]
+        slots = [(oh1, keep1, pos1, gate1)]
         if self.top_k == 2:
             sel2 = sel * (1.0 - oh1)           # mask the chosen expert
             oh2, pos2, keep2, gate2 = slot(sel2, oh1.sum(axis=1))
             # GShard gate renormalisation over the two chosen experts
             denom = jnp.maximum(gate1 + gate2, 1e-9)
-            slots = [(keep1, pos1, gate1 / denom),
-                     (keep2, pos2, gate2 / denom)]
+            slots = [(oh1, keep1, pos1, gate1 / denom),
+                     (oh2, keep2, pos2, gate2 / denom)]
 
-        # dispatch/combine as sums over slots — [G, Ng, E, C] one-hots;
-        # memory capacity_factor*top_k*N*Ng (linear in N for fixed groups)
-        dispatch = jnp.zeros((G, Ng, E, C), x.dtype)
-        combine = jnp.zeros((G, Ng, E, C), x.dtype)
-        for keep, pos, gate in slots:
-            pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
-                                    dtype=jnp.float32)         # [G, Ng, C]
-            piece = keep[..., None] * pos_oh[:, :, None, :]
-            dispatch = dispatch + piece.astype(x.dtype)
-            combine = combine + (piece * gate[..., None, None]
-                                 ).astype(x.dtype)
+        if self.dispatch_mode == "gather":
+            ein, picks = self._dispatch_gather(xg, slots, C)
+        elif self.dispatch_mode == "einsum":
+            # dispatch/combine as sums over slots — [G, Ng, E, C] one-hots;
+            # memory capacity_factor*top_k*N*Ng (linear in N, fixed groups)
+            dispatch = jnp.zeros((G, Ng, E, C), x.dtype)
+            combine = jnp.zeros((G, Ng, E, C), x.dtype)
+            for _, keep, pos, gate in slots:
+                pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
+                                        dtype=jnp.float32)     # [G, Ng, C]
+                piece = keep[..., None] * pos_oh[:, :, None, :]
+                dispatch = dispatch + piece.astype(x.dtype)
+                combine = combine + (piece * gate[..., None, None]
+                                     ).astype(x.dtype)
+            ein = jnp.einsum("gnec,gnd->gecd", dispatch, xg)
+        else:
+            raise ValueError(f"dispatch_mode must be 'einsum' or 'gather', "
+                             f"got {self.dispatch_mode!r}")
 
         # ---- expert compute, sharded over the expert axis ----
-        ein = jnp.einsum("gnec,gnd->gecd", dispatch, xg)
+        # checkpoint_name tags: under remat="dots" these matmul outputs are
+        # saved, so the backward recomputes only the routing one-hots and
+        # gelu — no expert matmul runs twice (parallel/pipeline.py
+        # SAVED_MATMUL_NAMES)
+        from jax.ad_checkpoint import checkpoint_name
         ein = _constrain(ein, P(None, "expert", None, None))
+        ein = checkpoint_name(ein, "moe_ein")
         h = jnp.einsum("gecd,edf->gecf", ein,
                        params["w_in"].astype(x.dtype))
-        h = jax.nn.gelu(h + params["b_in"].astype(x.dtype)[None, :, None, :])
+        h = checkpoint_name(
+            h + params["b_in"].astype(x.dtype)[None, :, None, :],
+            "moe_hpre")
+        h = jax.nn.gelu(h)
         out = jnp.einsum("gecf,efd->gecd", h,
                          params["w_out"].astype(x.dtype))
         out = out + params["b_out"].astype(x.dtype)[None, :, None, :]
         out = _constrain(out, P(None, "expert", None, None))
+        out = checkpoint_name(out, "moe_out")
 
-        y = jnp.einsum("gnec,gecd->gnd", combine, out)
+        if self.dispatch_mode == "gather":
+            outp = jnp.concatenate(
+                [out.reshape(G, E * C, d),
+                 jnp.zeros((G, 1, d), x.dtype)], axis=1)
+            y = jnp.zeros((G, Ng, d), x.dtype)
+            for dst, gate in picks:
+                pick = jnp.take_along_axis(outp, dst[..., None], axis=1)
+                y = y + pick * gate.astype(x.dtype)[..., None]
+        else:
+            y = jnp.einsum("gnec,gecd->gnd", combine, out)
 
         # Switch aux losses over top-1 assignments (float32 for stability)
         frac_tokens = oh1.mean((0, 1))                         # [E]
         frac_probs = probs.mean((0, 1))                        # [E]
         lb_loss = E * jnp.sum(frac_tokens * frac_probs)
         z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, -1)))
-        kept = sum(keep.sum() for keep, _, _ in slots)
+        kept = sum(keep.sum() for _, keep, _, _ in slots)
         dropped = 1.0 - kept / (N * len(slots))
         aux = {"lb_loss": lb_loss, "z_loss": z_loss,
                "dropped_fraction": dropped}
@@ -222,6 +295,7 @@ class MoETransformerConfig:
     moe_group_size: int | None = None  # routing group tokens (None = global)
     router_balance: str = "auto"       # balanced selection (see MoELayer)
     sinkhorn_iters: int = 3
+    dispatch_mode: str = "einsum"      # einsum | gather (see MoELayer)
     lb_weight: float = 0.01
     z_weight: float = 1e-3
     dropout_rate: float = 0.0
@@ -231,6 +305,7 @@ class MoETransformerConfig:
     pipeline_microbatches: int | None = None   # GPipe M (None = pipe size)
     # Megatron interleaved schedule (parallel/pipeline.py)
     virtual_stages: int = 1
+    unroll_layers: bool = True     # python-loop blocks (see GPT2Config)
     param_dtype: jnp.dtype = jnp.float32
 
     @classmethod
@@ -260,6 +335,7 @@ class MoETransformerLM:
                         top_k=c.top_k, group_size=c.moe_group_size,
                         router_balance=c.router_balance,
                         sinkhorn_iters=c.sinkhorn_iters,
+                        dispatch_mode=c.dispatch_mode,
                         param_dtype=c.param_dtype)
 
     def _block_init(self, key):
@@ -318,7 +394,7 @@ class MoETransformerLM:
         L_n = c.num_layers
         from distributed_compute_pytorch_tpu.core.mesh import current_mesh
         from distributed_compute_pytorch_tpu.parallel.pipeline import (
-            pipeline_blocks, remat_wrap)
+            pipeline_blocks, scan_blocks)
 
         mesh = current_mesh()
         if (mesh is not None and "pipe" in mesh.axis_names
@@ -337,21 +413,13 @@ class MoETransformerLM:
             lb, z, dr = (aux["lb_loss"], aux["z_loss"],
                          aux["dropped_fraction"])
         else:
-            block_apply = (remat_wrap(self._block_apply) if c.remat
-                           else self._block_apply)
-
-            def body(carry, scanned):
-                h, lb, z, dr = carry
-                i, p = scanned
-                r = (jax.random.fold_in(rng, i)
-                     if (rng is not None and train) else None)
-                h, aux = block_apply(p, h, r, train)
-                return (h, lb + aux["lb_loss"], z + aux["z_loss"],
-                        dr + aux["dropped_fraction"]), None
-
-            (x, lb, z, dr), _ = jax.lax.scan(
-                body, (x, jnp.float32(0), jnp.float32(0), jnp.float32(0)),
-                (jnp.arange(L_n), params["blocks"]))
+            zeros = {"lb_loss": 0.0, "z_loss": 0.0, "dropped_fraction": 0.0}
+            x, aux = scan_blocks(
+                self._block_apply, params["blocks"], x, rng=rng,
+                train=train, remat=c.remat, unroll=c.unroll_layers,
+                aux_init=zeros)
+            lb, z, dr = (aux["lb_loss"], aux["z_loss"],
+                         aux["dropped_fraction"])
         from distributed_compute_pytorch_tpu.core.mesh import (
             constrain_activations)
         x = constrain_activations(x)   # block-boundary layout discipline

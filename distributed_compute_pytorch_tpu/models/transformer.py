@@ -87,8 +87,12 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
 
     ``params``: ``{"qkv": Dense(d, 3d), "attn_out": Dense(d, d)}`` trees.
     """
+    from jax.ad_checkpoint import checkpoint_name
     d = x.shape[-1]
-    qkv = L.Dense(d, 3 * d).apply(params["qkv"], x)
+    # "qkv"/"attn_ctx" tags: saved under remat="dots" so the backward
+    # re-runs neither the projections nor the attention kernel
+    # (parallel/pipeline.py SAVED_MATMUL_NAMES)
+    qkv = checkpoint_name(L.Dense(d, 3 * d).apply(params["qkv"], x), "qkv")
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = A.split_heads(q, num_heads)
     k = A.split_heads(k, num_heads)
@@ -98,6 +102,7 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     o = dispatch_attention(q, k, v, causal=causal, seq_axis=seq_axis,
                            attn_impl=attn_impl, kv_mask=kv_mask,
                            manual_axes=manual_axes)
+    o = checkpoint_name(o, "attn_ctx")
     o = A.merge_heads(o)
     o = L.Dense(d, d).apply(params["attn_out"], o)
     return L.dropout(o, dropout_rate, rng, train)
@@ -148,7 +153,9 @@ class TransformerBlock:
             kv_mask=kv_mask, manual_axes=manual_axes, kv_sink=kv_sink)
 
     def _mlp(self, params, x, rng, train):
+        from jax.ad_checkpoint import checkpoint_name
         h = L.Dense(self.d_model, self.d_ff).apply(params["mlp_in"], x)
+        h = checkpoint_name(h, "mlp_pre")   # saved under remat="dots"
         h = jax.nn.gelu(h)
         h = L.Dense(self.d_ff, self.d_model).apply(params["mlp_out"], h)
         return L.dropout(h, self.dropout_rate, rng, train)

@@ -193,8 +193,8 @@ def decode_attention_pallas(q, k_cache, v_cache, pos, *,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hk, G, hd), lambda b, p: (b, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, Hk, G, hd), lambda b, p: (b, 0, 0, 0)),
     )

@@ -52,16 +52,43 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def remat_wrap(block_apply):
+# Intermediates worth their HBM under selective remat (remat="dots"): the
+# outputs of the block's big matmuls. With these saved, the backward
+# recomputes only elementwise work (gelu/softmax/routing one-hots) — no
+# matmul runs twice — while the quadratic/bulky tensors XLA would
+# otherwise keep (attention internals, expert dispatch one-hots) are
+# still dropped. Names are attached at the op sites via
+# ``jax.ad_checkpoint.checkpoint_name`` (models/transformer.py,
+# models/moe.py, models/llama.py).
+SAVED_MATMUL_NAMES = ("qkv", "attn_ctx", "mlp_pre", "moe_ein", "moe_hpre",
+                      "moe_out")
+
+
+def _remat_policy(mode):
+    """The jax.checkpoint policy for a remat mode: selective named saves
+    for "dots", full remat (save nothing) otherwise — the ONE place the
+    mode->policy mapping lives for both the scanned and pipelined paths."""
+    return (jax.checkpoint_policies.save_only_these_names(
+        *SAVED_MATMUL_NAMES) if mode == "dots" else None)
+
+
+def remat_wrap(block_apply, mode: bool | str = True):
     """``jax.checkpoint`` around one block: recompute its forward in the
     backward pass instead of saving intermediates — ~2-4x batch for one
     extra forward when HBM binds. ``prevent_cse=False`` because
     scan-over-layers already rules out the unsound CSE the checkpoint
     barriers guard against, and the barriers would block fusion on exactly
-    the HBM-bound runs that turn remat on."""
+    the HBM-bound runs that turn remat on.
+
+    ``mode``: ``True``/``"block"`` = full remat (save only the block
+    input); ``"dots"`` = selective — save the named matmul outputs
+    (:data:`SAVED_MATMUL_NAMES`), recompute the elementwise rest. "dots"
+    costs ~150 MB/layer at the MoE bench shapes instead of ~0, but the
+    backward re-runs no matmuls."""
     ck = jax.checkpoint(
         lambda p, h, r, t: block_apply(p, h, rng=r, train=t),
-        static_argnums=(3,), prevent_cse=False)
+        static_argnums=(3,), prevent_cse=False,
+        policy=_remat_policy(mode))
     return lambda p, h, rng=None, train=False: ck(p, h, rng, train)
 
 
@@ -78,7 +105,7 @@ def num_layers(stacked_params) -> int:
 
 def scan_blocks(block_apply, stacked_params, x, *, rng=None,
                 train: bool = False, remat: bool = False,
-                unroll: bool = False):
+                unroll: bool = False, aux_init=None):
     """Apply ``L`` stacked layers sequentially via ``lax.scan``.
 
     ``block_apply(layer_params, x, rng, train) -> x``. Per-layer dropout
@@ -97,27 +124,46 @@ def scan_blocks(block_apply, stacked_params, x, *, rng=None,
     sees the whole depth. Measured on GPT-2-small/v5e: 91.3 -> 76.1 ms per
     train step (-17%). Cost: compile time grows with ``L`` — keep scan for
     very deep stacks or compile-bound runs.
+
+    ``aux_init``: per-layer auxiliary accumulator (the same contract as
+    ``pipeline_blocks``). When given, ``block_apply`` returns ``(x, aux)``
+    with ``aux`` matching ``aux_init``'s pytree; the values are SUMMED
+    over layers and ``(x, aux_sums)`` is returned — MoE models carry
+    their load-balance/z losses this way.
     """
     L = num_layers(stacked_params)
-    apply = remat_wrap(block_apply) if remat else block_apply
+    apply = remat_wrap(block_apply, remat) if remat else block_apply
+    with_aux = aux_init is not None
+    add = lambda s, v: jax.tree.map(jnp.add, s, v)
 
     if unroll:
         h = x
+        aux = jax.tree.map(jnp.float32, aux_init)
         for i in range(L):
             p = jax.tree.map(lambda a: a[i], stacked_params)
             r = (jax.random.fold_in(rng, i)
                  if (rng is not None and train) else None)
-            h = apply(p, h, rng=r, train=train)
-        return h
+            out = apply(p, h, rng=r, train=train)
+            if with_aux:
+                h, a = out
+                aux = add(aux, a)
+            else:
+                h = out
+        return (h, aux) if with_aux else h
 
-    def body(h, scanned):
+    def body(carry, scanned):
         i, p = scanned
         r = (jax.random.fold_in(rng, i)
              if (rng is not None and train) else None)
-        return apply(p, h, rng=r, train=train), None
+        if with_aux:
+            h, aux = carry
+            h, a = apply(p, h, rng=r, train=train)
+            return (h, add(aux, a)), None
+        return apply(p, carry, rng=r, train=train), None
 
-    h, _ = lax.scan(body, x, (jnp.arange(L), stacked_params))
-    return h
+    init = (x, jax.tree.map(jnp.float32, aux_init)) if with_aux else x
+    out, _ = lax.scan(body, init, (jnp.arange(L), stacked_params))
+    return out
 
 
 def _block_extra_kwargs(block_apply) -> frozenset:
@@ -197,9 +243,9 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     Returns activations ``[B, T, d]``, replicated over ``pipe`` (other mesh
     axes keep their shardings — only ``pipe``/``seq`` are manual here).
     """
-    if remat not in (False, True, "block", "stage"):
-        raise ValueError(f"remat must be False, True/'block' or 'stage', "
-                         f"got {remat!r}")
+    if remat not in (False, True, "block", "stage", "dots"):
+        raise ValueError(f"remat must be False, True/'block', 'dots' or "
+                         f"'stage', got {remat!r}")
     extra = _block_extra_kwargs(block_apply)
     if kv_mask is not None and "kv_mask" not in extra:
         # loud, not silently-unmasked attention: a (p, h, rng, train)-only
@@ -223,7 +269,7 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             block_apply = (lambda p, h, rng=None, train=False:
                            inner(p, h, rng=rng, train=train, kv_mask=kv_mask))
         return scan_blocks(block_apply, stacked_params, x, rng=rng,
-                           train=train, remat=bool(remat))
+                           train=train, remat=remat)
     seq_manual = "seq" in mesh.axis_names and mesh.shape["seq"] > 1
     if seq_manual and "manual_axes" not in extra:
         raise NotImplementedError(
@@ -280,10 +326,11 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
             kw["manual_axes"] = manual
         return block_apply(p, h, rng=r, train=train, **kw)
 
-    if remat in (True, "block"):
+    if remat in (True, "block", "dots"):
         # per-block remat (see remat_wrap): only traced args reach the
         # checkpoint — train/manual_axes stay closed-over statics
-        call_block = jax.checkpoint(call_block, prevent_cse=False)
+        call_block = jax.checkpoint(call_block, prevent_cse=False,
+                                    policy=_remat_policy(remat))
 
     def stage_fn(params_slice, h, mk, layer_offset, mb_id):
         """Apply a contiguous run of layers (a full stage for GPipe, one

@@ -268,7 +268,8 @@ class Trainer:
                 if cfg.remat_mode == "stage" and not stage_ok:
                     log0("WARNING: --remat_mode stage needs a pipe>1 mesh; "
                          "falling back to per-block remat")
-                kw["remat"] = "stage" if stage_ok else True
+                kw["remat"] = ("stage" if stage_ok else
+                               "dots" if cfg.remat_mode == "dots" else True)
             else:
                 log0(f"WARNING: --remat is not supported by model "
                      f"{cfg.model!r} and will be ignored")

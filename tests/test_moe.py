@@ -396,3 +396,114 @@ def test_sinkhorn_rejects_top1():
     auto = MoELayer(d_model=16, d_ff=32, num_experts=4, top_k=1)
     y, aux = auto.apply(auto.init(jax.random.key(0)), x)
     assert np.isfinite(np.asarray(y)).all()
+
+
+@pytest.mark.parametrize("top_k,balance", [(1, "aux"), (2, "aux"),
+                                           (2, "sinkhorn")])
+def test_gather_dispatch_matches_einsum(top_k, balance):
+    """Gather dispatch == einsum dispatch: same routing decisions expressed
+    as row gathers, so outputs, aux losses, and drop accounting must agree
+    to float round-off — including under capacity pressure (forced drops)
+    and grouped routing."""
+    for cf, group in [(4.0, None), (0.5, None), (1.0, 16)]:
+        kw = dict(d_model=16, d_ff=32, num_experts=4, capacity_factor=cf,
+                  top_k=top_k, group_size=group, router_balance=balance)
+        ein = MoELayer(**kw, dispatch_mode="einsum")
+        gat = MoELayer(**kw, dispatch_mode="gather")
+        params = ein.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (2, 32, 16))
+        y_e, aux_e = ein.apply(params, x)
+        y_g, aux_g = gat.apply(params, x)
+        np.testing.assert_allclose(np.asarray(y_e), np.asarray(y_g),
+                                   rtol=1e-6, atol=1e-6)
+        for k in ("lb_loss", "z_loss", "dropped_fraction"):
+            np.testing.assert_allclose(float(aux_e[k]), float(aux_g[k]),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_gather_dispatch_gradients_match_einsum():
+    """Both dispatch formulations carry the same gradient: through the
+    gate (router) and through the dispatched tokens (experts + input)."""
+    def loss_fn(mode):
+        layer = MoELayer(d_model=16, d_ff=32, num_experts=4,
+                         capacity_factor=1.0, top_k=2,
+                         dispatch_mode=mode)
+
+        def f(params, x):
+            y, aux = layer.apply(params, x)
+            return jnp.sum(y ** 2) + aux["lb_loss"] + aux["z_loss"]
+        return layer, f
+
+    layer, f_e = loss_fn("einsum")
+    _, f_g = loss_fn("gather")
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 16))
+    ge = jax.grad(f_e, argnums=(0, 1))(params, x)
+    gg = jax.grad(f_g, argnums=(0, 1))(params, x)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), ge, gg)
+
+
+def test_gather_dispatch_expert_parallel_matches_replicated(devices8):
+    """The gather formulation stays layout-transparent: expert=4 sharded ==
+    DP-replicated train/eval steps, same shape as the einsum EP test."""
+    from dataclasses import replace
+    data = synthetic_lm(32, seq_len=16, vocab=256, seed=6)
+    cfg = replace(MoETransformerConfig.tiny(), dispatch_mode="gather",
+                  top_k=2, capacity_factor=2.0)
+
+    def run(spec, strategy):
+        mesh = make_mesh(spec, devices=devices8)
+        model = MoETransformerLM(cfg)
+        feed = DeviceFeeder(data, mesh, 32, shuffle=False)
+        tx = build_optimizer("adamw", lr=1e-3, gamma=1.0, steps_per_epoch=10)
+        init_fn, train_step, eval_step = make_step_fns(model, tx, mesh,
+                                                       strategy)
+        state = init_fn(jax.random.key(0))
+        (x, y), = list(feed.epoch(0))
+        for _ in range(2):
+            state, m = train_step(state, x, y)
+        em = eval_step(state, x, y)
+        return jax.device_get(state.params), float(m["loss"]), \
+            float(em["loss_sum"])
+
+    model = MoETransformerLM(cfg)
+    rules = ShardingRules(rules=model.partition_rules(),
+                          fallback=DataParallel())
+    p_ref, l_ref, e_ref = run("data=8", DataParallel())
+    p_ep, l_ep, e_ep = run("data=2,expert=4", rules)
+    np.testing.assert_allclose(l_ep, l_ref, rtol=2e-4)
+    np.testing.assert_allclose(e_ep, e_ref, rtol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_ep)):
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=3e-5)
+
+
+def test_remat_dots_and_unroll_match_baseline():
+    """remat='dots' (selective save) and unroll_layers change scheduling,
+    never math: loss and grads must match the no-remat scan baseline."""
+    from dataclasses import replace
+    base = replace(MoETransformerConfig.tiny(), top_k=2, capacity_factor=2.0,
+                   remat=False, unroll_layers=False)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0,
+                                base.vocab_size)
+
+    def loss_and_grad(cfg):
+        model = MoETransformerLM(cfg)
+        params, state = model.init(jax.random.key(0))
+
+        def loss(p):
+            return model.train_loss(p, state, tokens, None,
+                                    rng=None, train=False)[0]
+        l, g = jax.jit(jax.value_and_grad(loss))(params)
+        return float(l), g
+
+    l0, g0 = loss_and_grad(base)
+    for variant in (replace(base, remat="dots"),
+                    replace(base, unroll_layers=True),
+                    replace(base, remat="dots", unroll_layers=True),
+                    replace(base, remat=True, unroll_layers=True)):
+        l, g = loss_and_grad(variant)
+        np.testing.assert_allclose(l, l0, rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g, g0)
