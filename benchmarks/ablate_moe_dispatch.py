@@ -14,9 +14,10 @@ to the dispatch einsum's MXU one-hot matmuls.
 Usage:  python benchmarks/ablate_moe_dispatch.py [einsum gather]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import _PEAK_BF16, _bench_moe  # noqa: E402
 

@@ -397,6 +397,7 @@ class MoETransformerLM:
             pipeline_blocks, scan_blocks)
 
         mesh = current_mesh()
+        zeros = {"lb_loss": 0.0, "z_loss": 0.0, "dropped_fraction": 0.0}
         if (mesh is not None and "pipe" in mesh.axis_names
                 and mesh.shape["pipe"] > 1):
             # GPipe path: the pipeline sums aux over layers and averages
@@ -404,22 +405,18 @@ class MoETransformerLM:
             # for these mean-based metrics when moe_group_size divides the
             # microbatch's tokens). _block_apply's own signature already
             # fits the pipeline's block contract.
-            zeros = {"lb_loss": 0.0, "z_loss": 0.0, "dropped_fraction": 0.0}
             x, aux = pipeline_blocks(
                 self._block_apply, params["blocks"], x, mesh,
                 num_microbatches=c.pipeline_microbatches, rng=rng,
                 train=train, remat=c.remat, aux_init=zeros,
                 virtual_stages=c.virtual_stages)
-            lb, z, dr = (aux["lb_loss"], aux["z_loss"],
-                         aux["dropped_fraction"])
         else:
-            zeros = {"lb_loss": 0.0, "z_loss": 0.0, "dropped_fraction": 0.0}
             x, aux = scan_blocks(
                 self._block_apply, params["blocks"], x, rng=rng,
                 train=train, remat=c.remat, unroll=c.unroll_layers,
                 aux_init=zeros)
-            lb, z, dr = (aux["lb_loss"], aux["z_loss"],
-                         aux["dropped_fraction"])
+        lb, z, dr = (aux["lb_loss"], aux["z_loss"],
+                     aux["dropped_fraction"])
         from distributed_compute_pytorch_tpu.core.mesh import (
             constrain_activations)
         x = constrain_activations(x)   # block-boundary layout discipline

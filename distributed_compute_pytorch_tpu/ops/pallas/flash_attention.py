@@ -351,8 +351,15 @@ def _flash(q, k, v, scale, causal, block_q, block_k):
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
     o, lse = _flash_fwd(q, k, v, None, 1, scale, causal, block_q, block_k)
-    # the [bh, t, 1] single-lane lse flows to the backward unchanged
+    # the [bh, t, 1] single-lane lse flows to the backward unchanged.
+    # Tags: under remat="dots" the RESIDUALS must be the saveable tensors
+    # (a tag applied by the caller to the custom_vjp's OUTPUT marks a
+    # different equation), so o/lse are named here and the kernel itself
+    # is never re-run in the backward.
+    o = checkpoint_name(o, "attn_ctx")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -372,8 +379,11 @@ def _flash_masked(q, k, v, kv_mask, heads, scale, causal, block_q, block_k):
 
 def _flash_masked_vjp_fwd(q, k, v, kv_mask, heads, scale, causal,
                           block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
     o, lse = _flash_fwd(q, k, v, kv_mask, heads, scale, causal,
                         block_q, block_k)
+    o = checkpoint_name(o, "attn_ctx")       # see _flash_vjp_fwd
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, o, lse, kv_mask)
 
 

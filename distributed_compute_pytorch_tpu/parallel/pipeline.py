@@ -60,8 +60,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 # still dropped. Names are attached at the op sites via
 # ``jax.ad_checkpoint.checkpoint_name`` (models/transformer.py,
 # models/moe.py, models/llama.py).
-SAVED_MATMUL_NAMES = ("qkv", "attn_ctx", "mlp_pre", "moe_ein", "moe_hpre",
-                      "moe_out")
+SAVED_MATMUL_NAMES = ("qkv", "attn_ctx", "attn_lse", "mlp_pre",
+                      "moe_ein", "moe_hpre", "moe_out")
 
 
 def _remat_policy(mode):

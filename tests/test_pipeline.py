@@ -71,7 +71,7 @@ def test_pipeline_gradients_match_scan(devices8):
                                    rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("remat", [True, "stage"])
+@pytest.mark.parametrize("remat", [True, "stage", "dots"])
 def test_pipeline_remat_matches_scan(devices8, remat):
     """Block- and stage-level remat change only what autodiff saves, never
     the numerics: outputs AND gradients == plain scan."""
@@ -223,7 +223,7 @@ def test_transformer_pipe_seq_matches_scan(devices8):
                                rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("remat", [False, "block", "stage"])
+@pytest.mark.parametrize("remat", [False, "block", "stage", "dots"])
 def test_transformer_pipe_masked_matches_scan(devices8, remat):
     """Padding masks under the pipeline (VERDICT r2: formerly rejected):
     the mask is microbatched alongside x and each stage reads its slice —
