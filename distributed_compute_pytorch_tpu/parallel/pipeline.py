@@ -53,13 +53,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 # Intermediates worth their HBM under selective remat (remat="dots"): the
-# outputs of the block's big matmuls. With these saved, the backward
+# outputs of the block's big matmuls, plus the flash kernel's softmax
+# stats ("attn_lse" — tiny, but with it and "attn_ctx" saved the Pallas
+# forward kernel never re-runs). With these saved, the backward
 # recomputes only elementwise work (gelu/softmax/routing one-hots) — no
 # matmul runs twice — while the quadratic/bulky tensors XLA would
 # otherwise keep (attention internals, expert dispatch one-hots) are
 # still dropped. Names are attached at the op sites via
-# ``jax.ad_checkpoint.checkpoint_name`` (models/transformer.py,
-# models/moe.py, models/llama.py).
+# ``jax.ad_checkpoint.checkpoint_name``: models/transformer.py,
+# models/moe.py, models/llama.py, and — for attn_ctx/attn_lse — INSIDE
+# the custom_vjp forward rules in ops/pallas/flash_attention.py (a tag
+# on the custom_vjp's output marks a different equation than its
+# residuals; tests/test_moe.py::test_remat_dots_recomputes_no_big_matmul
+# pins the contract).
 SAVED_MATMUL_NAMES = ("qkv", "attn_ctx", "attn_lse", "mlp_pre",
                       "moe_ein", "moe_hpre", "moe_out")
 
