@@ -408,7 +408,10 @@ def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops,
     # measured 144.4 -> 118.2 ms (active-MFU 0.346 -> 0.422). The
     # remaining gap to ~0.5 is the dispatch/combine einsums' non-expert
     # FLOPs (~17%) and the routing recompute (saving the one-hots too
-    # measured flat, 119.7 — not worth 0.8 GB).
+    # measured flat, 119.7 — not worth 0.8 GB). Re-swept under dots
+    # (2026-07-31): group 256 measures 114.6 ms but drops 2.8% vs 512's
+    # 2.1% — the 1.4% speed is not worth the quality tax; B=12 is
+    # per-token slower (69.7k vs 71.5k tok/s) and B=16 OOMs.
     cfg = MoETransformerConfig(num_experts=8, top_k=2, moe_group_size=512,
                                capacity_factor=1.0, dropout_rate=0.0,
                                remat=remat, dispatch_mode=dispatch_mode)
@@ -505,7 +508,8 @@ def _bench_eval(jax, jnp, np, mesh, n_chips):
     }
 
 
-def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2"):
+def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2",
+                  quantize: bool = False):
     """KV-cache decode throughput (the inference path the reference never
     had): 16 sequences/chip, prompt 128, greedy, bf16 params, batch
     sharded over the data axis so every chip decodes. ``which`` picks the
@@ -543,6 +547,13 @@ def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2"):
     params = jax.tree.map(lambda p: p.astype(jnp.bfloat16)
                           if jnp.issubdtype(p.dtype, jnp.floating) else p,
                           params)
+    if quantize:
+        # weight-only int8 (utils/quantize.py): halves the per-tick
+        # weight stream; the mixed-dtype dot consumes int8 directly
+        # (ops/int8_matmul.py docstring has the formulation A/B)
+        from distributed_compute_pytorch_tpu.utils.quantize import (
+            quantize_params_int8)
+        params = jax.jit(quantize_params_int8)(params)
     prompt = jax.device_put(
         jax.random.randint(jax.random.key(1), (B, T0), 0,
                            cfg.vocab_size, jnp.int32),
@@ -578,9 +589,11 @@ def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2"):
 
     per_tok = _two_length_dt(time_n, K * 128, repeats=5)
 
-    # HBM byte model per tick: all params (bf16) + the k+v cache window
-    # the masked attention reads (t_max slots, kv-head width, all layers)
-    n_params = sum(l.size for l in jax.tree.leaves(params))
+    # HBM byte model per tick: all params (bf16, or int8+scales when
+    # quantized — counted from the actual leaf bytes) + the k+v cache
+    # window the masked attention reads (t_max slots, kv-heads, all layers)
+    n_weight_bytes = sum(l.size * l.dtype.itemsize
+                         for l in jax.tree.leaves(params))
     hk, hd = model.kv_cache_spec()
     t_max = T0 + 256
     # PER-CHIP bytes: the batch (and so the cache) shards over data;
@@ -593,7 +606,7 @@ def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2"):
     inplace = n_chips == 1
     copy_bytes = 0 if inplace else 2 * cache_bytes
     hbm_bw = _PEAK_HBM.get(jax.devices()[0].device_kind)
-    floor_ms = ((2 * n_params + cache_bytes + copy_bytes) / hbm_bw * 1e3
+    floor_ms = ((n_weight_bytes + cache_bytes + copy_bytes) / hbm_bw * 1e3
                 if hbm_bw else None)
     return {
         "batch": B, "prompt_len": T0, "new_tokens": 128,
@@ -601,7 +614,7 @@ def _bench_decode(jax, jnp, np, mesh, n_chips, which: str = "gpt2"):
         "decode_tokens_per_sec_per_chip": round(B / per_tok / n_chips, 1),
         "bound": "hbm_weights+kv_cache",
         "cache_write": "pallas_inplace" if inplace else "xla_dus_copy",
-        "weights_mb": round(2 * n_params / 1e6, 1),
+        "weights_mb": round(n_weight_bytes / 1e6, 1),
         "kv_cache_mb": round(cache_bytes / 1e6, 1),
         "roofline_ms": round(floor_ms, 3) if floor_ms else None,
         "hbm_efficiency": (round(floor_ms / (per_tok * 1e3), 3)
@@ -716,6 +729,8 @@ def main():
     # ladder vs 0.51 in a fresh process, 5-repeat stable either way)
     dec = _stage(_bench_decode, jax, jnp, np, mesh, n_chips)
     dec_ll = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "llama")
+    dec_ll_q = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "llama",
+                      True)
     gpt2 = _stage(_bench_gpt2, jax, jnp, np, mesh, n_chips, peak)
     llama = _stage(_bench_llama, jax, jnp, np, mesh, n_chips, peak)
     resnet = _stage(_bench_resnet18, jax, jnp, np, mesh, n_chips, peak)
@@ -747,6 +762,7 @@ def main():
             "gpt2_eval_bf16_t1024": ev,
             "gpt2_decode_kvcache_bf16": dec,
             "llama_decode_kvcache_gqa_bf16": dec_ll,
+            "llama_decode_kvcache_gqa_int8": dec_ll_q,
             "flash_vs_dense_attention_bf16": attn,
             # pipeline parallelism needs >1 device; its bubble is
             # quantified on the faked 8-device mesh in
@@ -801,6 +817,7 @@ def main():
             "decode_per_tick_ms": {
                 "gpt2": _pick(dec, "per_tick_ms"),
                 "llama": _pick(dec_ll, "per_tick_ms"),
+                "llama_int8": _pick(dec_ll_q, "per_tick_ms"),
             },
             "flash_speedup": {
                 k: (v.get("speedup") if isinstance(v, dict) else None)

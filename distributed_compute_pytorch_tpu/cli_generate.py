@@ -75,6 +75,10 @@ def main(argv=None) -> int:
     p.add_argument("--eos_id", type=int, default=None,
                    help="stop a row at this token id (output is trimmed "
                         "at the first occurrence)")
+    p.add_argument("--quantize", default=None, choices=("int8",),
+                   help="weight-only int8 inference: halves the decode "
+                        "tick's weight-stream bytes on one TPU chip "
+                        "(utils/quantize.py; incompatible with --mesh)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
     args = p.parse_args(argv)
@@ -102,6 +106,16 @@ def main(argv=None) -> int:
     # the sharded-restore path for bigger-than-one-chip checkpoints
     template = jax.eval_shape(lambda k: model.init(k)[0],
                               jax.random.key(0))
+    if args.quantize == "int8" and args.mesh is not None:
+        # quantized leaves are {q, scale} dicts, so the `.../kernel$`
+        # shard-spec regexes no longer match the tree paths and the
+        # training-layout restore cannot be reproduced — quantization
+        # targets the single-chip decode bound, sharding targets
+        # bigger-than-chip models; pick one (checked BEFORE the restore
+        # so a multi-GB sharded load is not wasted on the way to the
+        # error)
+        raise SystemExit("--quantize int8 is single-chip "
+                         "(incompatible with --mesh)")
     mesh = None
     if args.mesh is not None:
         from distributed_compute_pytorch_tpu.core.mesh import make_mesh
@@ -115,6 +129,11 @@ def main(argv=None) -> int:
         params = restore_params(args.ckpt_path, template, shardings)
     else:
         params = restore_params(args.ckpt_path, template)
+
+    if args.quantize == "int8":
+        from distributed_compute_pytorch_tpu.utils.quantize import (
+            quantize_params_int8)
+        params = jax.jit(quantize_params_int8)(params)
 
     tok = None
     if args.text_prompt is not None:

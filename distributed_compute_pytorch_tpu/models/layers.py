@@ -59,7 +59,16 @@ class Dense:
         return p
 
     def apply(self, params, x):
-        y = x @ params["kernel"].astype(x.dtype)
+        k = params["kernel"]
+        if isinstance(k, dict):      # weight-only int8 (utils/quantize.py)
+            from distributed_compute_pytorch_tpu.ops.int8_matmul import (
+                int8_matmul)
+            from distributed_compute_pytorch_tpu.utils.quantize import (
+                is_quantized)
+            assert is_quantized(k), f"unknown kernel-dict keys {set(k)}"
+            y = int8_matmul(x, k["q"], k["scale"])
+        else:
+            y = x @ k.astype(x.dtype)
         if self.use_bias:
             y = y + params["bias"].astype(x.dtype)
         return y
@@ -257,7 +266,16 @@ class Embedding:
             key, (self.vocab_size, self.features), self.param_dtype)}
 
     def apply(self, params, ids):
-        out = params["embedding"][ids]
+        t = params["embedding"]
+        if isinstance(t, dict):      # int8 table: dequant after gather
+            from distributed_compute_pytorch_tpu.utils.quantize import (
+                is_quantized)
+            assert is_quantized(t), f"unknown embedding-dict keys {set(t)}"
+            out = (t["q"][ids].astype(jnp.float32)
+                   * t["scale"][ids].astype(jnp.float32)
+                   ).astype(t["scale"].dtype)
+        else:
+            out = t[ids]
         # Pin the gather's output layout. Under 3-axis meshes (batch over
         # data x fsdp, table over fsdp x tensor) XLA's SPMD partitioner
         # MISCOMPILES an unannotated gather feeding a residual + TP-matmul
@@ -280,7 +298,15 @@ class Embedding:
 
     def attend(self, params, x):
         """Tied-softmax readout: ``x @ E^T``."""
-        return x @ params["embedding"].astype(x.dtype).T
+        t = params["embedding"]
+        if isinstance(t, dict):      # per-row scales = transposed channels
+            from distributed_compute_pytorch_tpu.ops.int8_matmul import (
+                int8_matmul)
+            from distributed_compute_pytorch_tpu.utils.quantize import (
+                is_quantized)
+            assert is_quantized(t), f"unknown embedding-dict keys {set(t)}"
+            return int8_matmul(x, t["q"], t["scale"], transpose=True)
+        return x @ t.astype(x.dtype).T
 
 
 def log_softmax(x, axis: int = -1):

@@ -1,0 +1,79 @@
+"""Weight-only int8 quantization for inference.
+
+``quantize_params_int8`` rewrites a trained/restored param pytree so
+every matmul weight is stored as ``{"q": int8, "scale": f32}`` instead
+of a float array; the layer library (``models/layers.py``) recognises
+the dict and routes through ``ops/int8_matmul.py``, which
+streams the weights from HBM at half the bf16 bytes on a single TPU
+chip (the decode path's bound — see the kernel docstring for measured
+numbers). Symmetric per-channel quantization over the contraction
+axis:
+
+- Dense kernels ``[K, N]`` (and stacked ``[L, K, N]``): one scale per
+  output channel (axis ``-2`` reduced) — the scale commutes out of the
+  contraction, so dequantising the OUTPUT is exact.
+- Embedding tables ``[V, d]``: one scale per vocab row, which serves
+  both the lookup (dequant after gather) and the tied readout
+  ``x @ table.T`` (per-row scale = per-output-channel of the
+  transposed matmul).
+
+Inference-only: quantized pytrees are for ``infer.generate`` /
+``dcp-generate --quantize int8``; the training step never sees them.
+Biases, norms, and routers stay in float — they are a rounding error
+of the byte budget.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# param-leaf names that hold matmul weights (the contraction is always
+# over the second-to-last axis; see models/layers.py Dense)
+_KERNEL_NAMES = ("kernel",)
+_EMBED_NAMES = ("embedding",)
+
+
+def is_quantized(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q", "scale"}
+
+
+def _quantize(w, axis: int):
+    """Symmetric int8 over ``axis`` (the contraction axis): scale keeps
+    that axis reduced, broadcasting exactly in the dequant."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w32), axis=axis, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    # scale carries the SOURCE dtype: the layer hooks dequantise back to
+    # it, so an f32 pytree keeps f32 activations (and the cached==full
+    # generation exactness) while a bf16 inference tree stays bf16
+    return {"q": q, "scale": scale.astype(w.dtype)}
+
+
+def quantize_params_int8(params):
+    """Quantize every Dense kernel and embedding table in ``params``.
+
+    Kernels (``*/kernel`` with ndim >= 2, except 1-wide routers) are
+    quantized per output channel; embeddings per row. Everything else
+    passes through unchanged. The result is a pytree whose quantized
+    leaves are ``{"q", "scale"}`` dicts the layer library consumes.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        name = getattr(path[-1], "key", None)
+        keys = [getattr(k, "key", None) for k in path]
+        # routers decide DISCRETE expert assignment — a rounding-flipped
+        # argmax changes which expert runs, not just a low-order bit, and
+        # the router matmul is [d, E]-tiny anyway. Conv kernels (ndim 4)
+        # contract over H*W*I, not axis -2 — out of scope for the decode
+        # path this exists for.
+        if ("router" not in keys and name in _KERNEL_NAMES
+                and getattr(leaf, "ndim", 0) in (2, 3)):
+            out.append(_quantize(leaf, axis=-2))
+        elif name in _EMBED_NAMES and getattr(leaf, "ndim", 0) == 2:
+            out.append(_quantize(leaf, axis=-1))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)

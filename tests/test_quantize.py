@@ -1,0 +1,138 @@
+"""Weight-only int8 quantization (utils/quantize.py + the layer hooks +
+ops/int8_matmul.py).
+
+One code path on every backend — the mixed-dtype dot is plain XLA — so
+these CPU tests cover the same program the TPU runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.models import layers as L
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.models.llama import (
+    LlamaConfig, LlamaLM)
+from distributed_compute_pytorch_tpu.utils.quantize import (
+    is_quantized, quantize_params_int8)
+
+
+def test_quantize_roundtrip_error_bound():
+    """Symmetric per-channel int8: |w - dequant(q)| <= scale/2 elementwise
+    (half a quantization step), scale = per-channel max/127."""
+    w = jax.random.normal(jax.random.key(0), (64, 48)) * 0.1
+    q = quantize_params_int8({"kernel": w})["kernel"]
+    assert is_quantized(q)
+    deq = q["q"].astype(jnp.float32) * q["scale"]
+    bound = np.asarray(q["scale"]) / 2 + 1e-7
+    np.testing.assert_array_less(np.abs(np.asarray(deq - w)),
+                                 np.broadcast_to(bound, w.shape))
+
+
+def test_dense_quantized_close_to_full():
+    layer = L.Dense(64, 48)
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (4, 64))
+    full = layer.apply(params, x)
+    qp = quantize_params_int8(params)
+    assert is_quantized(qp["kernel"]) and not is_quantized(qp["bias"])
+    quant = layer.apply(qp, x)
+    # int8 weights: ~0.4% worst-case relative weight error; activations
+    # accumulate over K=64
+    np.testing.assert_allclose(np.asarray(quant), np.asarray(full),
+                               rtol=0.05, atol=0.05)
+
+
+def test_embedding_lookup_and_attend_quantized():
+    emb = L.Embedding(96, 32)
+    params = quantize_params_int8(emb.init(jax.random.key(0)))
+    assert is_quantized(params["embedding"])
+    ids = jnp.array([[1, 5, 90], [0, 2, 3]])
+    out = emb.apply(params, ids)
+    assert out.shape == (2, 3, 32)
+    x = jax.random.normal(jax.random.key(1), (2, 3, 32), jnp.bfloat16)
+    logits = emb.attend(params, x)
+    assert logits.shape == (2, 3, 96)
+
+
+def test_router_and_conv_kernels_not_quantized():
+    """Routers make DISCRETE decisions and conv kernels contract over
+    H*W*I — both must pass through untouched."""
+    from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+    from distributed_compute_pytorch_tpu.models.moe import (
+        MoETransformerConfig, MoETransformerLM)
+    moe_params, _ = MoETransformerLM(
+        MoETransformerConfig.tiny()).init(jax.random.key(0))
+    q = quantize_params_int8(moe_params)
+    assert not is_quantized(q["blocks"]["moe"]["router"]["kernel"])
+    assert is_quantized(q["blocks"]["qkv"]["kernel"])
+    conv_params, _ = ConvNet().init(jax.random.key(0))
+    qc = quantize_params_int8(conv_params)
+    assert not is_quantized(qc["conv1"]["kernel"])
+    assert is_quantized(qc["fc1"]["kernel"])
+
+
+@pytest.mark.parametrize("name,model", [
+    ("gpt2", GPT2(GPT2Config.tiny())),
+    ("llama", LlamaLM(LlamaConfig.tiny())),
+])
+def test_quantized_generate_cached_matches_full(name, model):
+    """The generation invariant survives quantization EXACTLY: cached
+    greedy decode with int8 params == per-step full forwards with the
+    SAME int8 params (both paths consume identical quantized weights, so
+    this is bit-parity of the plumbing, not a tolerance test)."""
+    from distributed_compute_pytorch_tpu.infer import generate
+    params, _ = model.init(jax.random.key(0))
+    params = jax.jit(quantize_params_int8)(params)
+    B, T0, N = 2, 8, 8
+    prompt = jax.random.randint(jax.random.key(1), (B, T0), 0, 256)
+    out = generate(model, params, prompt, N)
+    assert out.shape == (B, T0 + N)
+    toks = prompt
+    for _ in range(N):
+        logits, _ = model.apply(params, {}, toks, train=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(toks.dtype)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+def test_quantized_forward_close_to_full():
+    """Quantized logits track full-precision logits (weight-only int8 is
+    a small perturbation, not a rewrite): top-1 agreement on most
+    positions and bounded logit error."""
+    model = LlamaLM(LlamaConfig.tiny())
+    params, _ = model.init(jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 256)
+    full, _ = model.apply(params, {}, toks, train=False)
+    quant, _ = model.apply(jax.jit(quantize_params_int8)(params), {},
+                           toks, train=False)
+    err = np.abs(np.asarray(quant, np.float32) - np.asarray(full, np.float32))
+    spread = float(np.asarray(full, np.float32).std())
+    assert err.max() < 0.35 * spread, (err.max(), spread)
+    agree = (np.asarray(quant.argmax(-1)) == np.asarray(full.argmax(-1)))
+    assert agree.mean() > 0.8, agree.mean()
+
+
+def test_int8_matmul_matches_dequant_reference():
+    """The mixed-dtype dot == an explicit dequant matmul, both
+    orientations (the scale commutes out of the contraction)."""
+    from distributed_compute_pytorch_tpu.ops.int8_matmul import (
+        int8_matmul)
+    x = jax.random.normal(jax.random.key(0), (3, 16, 768), jnp.bfloat16)
+    w = jax.random.normal(jax.random.key(1), (768, 1536)) * 0.02
+    q = quantize_params_int8({"kernel": w})["kernel"]
+    out = int8_matmul(x, q["q"], q["scale"])
+    deq = (q["q"].astype(jnp.float32) * q["scale"]).astype(jnp.bfloat16)
+    ref = x @ deq
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+    table = jax.random.normal(jax.random.key(2), (1024, 768)) * 0.02
+    qt = quantize_params_int8({"embedding": table})["embedding"]
+    out_t = int8_matmul(x, qt["q"], qt["scale"], transpose=True)
+    deq_t = (qt["q"].astype(jnp.float32) * qt["scale"]).astype(jnp.bfloat16)
+    ref_t = x @ deq_t.T
+    np.testing.assert_allclose(
+        np.asarray(out_t, np.float32), np.asarray(ref_t, np.float32),
+        rtol=2e-2, atol=2e-2)
