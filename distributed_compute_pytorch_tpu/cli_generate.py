@@ -77,8 +77,9 @@ def main(argv=None) -> int:
                         "at the first occurrence)")
     p.add_argument("--quantize", default=None, choices=("int8",),
                    help="weight-only int8 inference: halves the decode "
-                        "tick's weight-stream bytes on one TPU chip "
-                        "(utils/quantize.py; incompatible with --mesh)")
+                        "tick's weight-stream bytes (utils/quantize.py; "
+                        "composes with --mesh — params quantize in the "
+                        "restored layout)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
     args = p.parse_args(argv)
@@ -106,16 +107,6 @@ def main(argv=None) -> int:
     # the sharded-restore path for bigger-than-one-chip checkpoints
     template = jax.eval_shape(lambda k: model.init(k)[0],
                               jax.random.key(0))
-    if args.quantize == "int8" and args.mesh is not None:
-        # quantized leaves are {q, scale} dicts, so the `.../kernel$`
-        # shard-spec regexes no longer match the tree paths and the
-        # training-layout restore cannot be reproduced — quantization
-        # targets the single-chip decode bound, sharding targets
-        # bigger-than-chip models; pick one (checked BEFORE the restore
-        # so a multi-GB sharded load is not wasted on the way to the
-        # error)
-        raise SystemExit("--quantize int8 is single-chip "
-                         "(incompatible with --mesh)")
     mesh = None
     if args.mesh is not None:
         from distributed_compute_pytorch_tpu.core.mesh import make_mesh
@@ -131,6 +122,12 @@ def main(argv=None) -> int:
         params = restore_params(args.ckpt_path, template)
 
     if args.quantize == "int8":
+        # quantize AFTER the (possibly sharded) restore: the jitted
+        # transform's outputs inherit the restored layout via SPMD, so
+        # q/scale stay sharded exactly where the float kernels were and
+        # the mixed-dtype dots partition like any other dot — sharded
+        # int8 serving composes (pinned by tests/test_quantize.py's mesh
+        # case, bit-equal to the single-device quantized run)
         from distributed_compute_pytorch_tpu.utils.quantize import (
             quantize_params_int8)
         params = jax.jit(quantize_params_int8)(params)

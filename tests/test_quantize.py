@@ -136,3 +136,30 @@ def test_int8_matmul_matches_dequant_reference():
     np.testing.assert_allclose(
         np.asarray(out_t, np.float32), np.asarray(ref_t, np.float32),
         rtol=2e-2, atol=2e-2)
+
+
+def test_quantized_generate_under_mesh_matches_single_device(devices8):
+    """Sharded int8 serving: restore-layout params quantized under jit
+    keep their shardings (SPMD propagates through the transform), and
+    mesh generation with int8 params == the single-device quantized run
+    bit-for-bit — the mixed-dtype dots partition like any other dot."""
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh, use_mesh
+    from distributed_compute_pytorch_tpu.infer import generate
+    from distributed_compute_pytorch_tpu.parallel.api import (
+        pick_strategy, tree_shardings)
+
+    model = LlamaLM(LlamaConfig.tiny())
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (4, 8), 0, 256)
+    ref = np.asarray(generate(model, jax.jit(quantize_params_int8)(params),
+                              prompt, 8))
+    mesh = make_mesh("data=2,tensor=2", devices=devices8[:4])
+    with use_mesh(mesh):
+        shardings = tree_shardings(pick_strategy(mesh, model),
+                                   jax.eval_shape(lambda: params), mesh)
+        sharded = jax.device_put(params, shardings)
+        q_sharded = jax.jit(quantize_params_int8)(sharded)
+    # mesh= passed EXPLICITLY — the dcp-generate path (kv-head checks,
+    # mesh-keyed fn cache), not just the ambient-context one
+    out = np.asarray(generate(model, q_sharded, prompt, 8, mesh=mesh))
+    np.testing.assert_array_equal(out, ref)
