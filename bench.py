@@ -1,24 +1,27 @@
 #!/usr/bin/env python3
 """Headline benchmark — run by the driver on real TPU hardware.
 
-Three stages (VERDICT r1 next-round #1/#2):
+The headline stage (BASELINE.json north star): samples/sec/chip training
+the reference's default model (the MNIST ConvNet of
+``/root/reference/main.py:20-45``) at the reference's default global
+batch (128, ``main.py:139``) with the reference optimizer stack.
+``vs_baseline`` compares against the measured torch-CPU number in
+``benchmarks/baseline_measured.json`` (the reference publishes none).
 
-1. **ConvNet rung (headline metric, BASELINE.json north star)**:
-   samples/sec/chip training the reference's default model (the MNIST
-   ConvNet of ``/root/reference/main.py:20-45``) at the reference's default
-   global batch (128, ``main.py:139``) with the reference optimizer stack.
-   ``vs_baseline`` compares against the measured torch-CPU number in
-   ``benchmarks/baseline_measured.json`` (the reference publishes none).
-2. **GPT-2-small rung (BASELINE.json configs[4])**: full-size GPT-2-small
-   (124M params) train step in bfloat16 at T=1024, reporting
-   samples/sec/chip, tokens/sec/chip and **MFU** against the chip's peak
-   bf16 FLOPs (per-token FLOPs = 6N + 12·L·T·d).
-3. **Flash attention (Pallas) vs dense XLA**: fwd latency at T=1024/4096,
-   timed on-device via lax.scan so relay dispatch overhead doesn't pollute
-   the numbers.
+Then the ladder, grown round by round: GPT-2-small / Llama-125M /
+BERT-base / ResNet-18 / ResNet-50 / 8-expert MoE train steps in bf16
+with MFU (per-token FLOPs = 6N + 12·L·T·d for the LMs; XLA cost
+analysis for the convnets, with roofline attribution where HBM binds),
+an eval-pass stage, KV-cache decode for both causal families (bf16 and
+weight-only int8, latency B=16 and throughput B=64 points, each with a
+weights+cache HBM byte model and achieved fraction), and flash-vs-dense
+attention at T=1k/4k/8k.
 
-Stages 2-3 run on TPU only (skipped markers elsewhere). Prints exactly ONE
-JSON line: {"metric", "value", "unit", "vs_baseline", "extra": {...}}.
+Non-ConvNet stages run on TPU only (skipped markers elsewhere). Prints
+exactly ONE compact JSON line: {"metric", "value", "unit",
+"vs_baseline", "extra": {...}} (the full per-stage record goes to
+benchmarks/bench_details_latest.json — the printed line must stay small
+enough for the driver to capture and parse).
 
 Timing discipline: completion is forced by a device->host fetch of a value
 that depends on the last step — block_until_ready can ack early on relayed
