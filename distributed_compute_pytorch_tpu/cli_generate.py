@@ -75,11 +75,15 @@ def main(argv=None) -> int:
     p.add_argument("--eos_id", type=int, default=None,
                    help="stop a row at this token id (output is trimmed "
                         "at the first occurrence)")
-    p.add_argument("--quantize", default=None, choices=("int8",),
-                   help="weight-only int8 inference: halves the decode "
-                        "tick's weight-stream bytes (utils/quantize.py; "
-                        "composes with --mesh — params quantize in the "
-                        "restored layout)")
+    p.add_argument("--quantize", default=None, choices=("int8", "int8-kv"),
+                   help="int8 inference: 'int8' quantizes the weights "
+                        "(halves the decode tick's weight stream — "
+                        "measured faster), 'int8-kv' additionally "
+                        "stores the KV cache as int8 with per-row "
+                        "scales — halves cache MEMORY (longer contexts "
+                        "per chip) but measured SLOWER per tick on "
+                        "v5e (ops/attention.py::cached_attention_q8). "
+                        "Both compose with --mesh")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
     args = p.parse_args(argv)
@@ -121,7 +125,7 @@ def main(argv=None) -> int:
     else:
         params = restore_params(args.ckpt_path, template)
 
-    if args.quantize == "int8":
+    if args.quantize in ("int8", "int8-kv"):
         # quantize AFTER the (possibly sharded) restore: the jitted
         # transform's outputs inherit the restored layout via SPMD, so
         # q/scale stay sharded exactly where the float kernels were and
@@ -203,7 +207,7 @@ def main(argv=None) -> int:
                    temperature=args.temperature, eos_id=args.eos_id,
                    top_k=args.top_k, top_p=args.top_p,
                    rng=jax.random.key(args.seed), prompt_mask=prompt_mask,
-                   mesh=mesh)
+                   mesh=mesh, kv_quant=args.quantize == "int8-kv")
     out = np.asarray(out)
     for i, ids in enumerate(prompts):
         toks = [int(t) for t in out[i, T0 - len(ids):]]

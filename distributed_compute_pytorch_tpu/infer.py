@@ -52,8 +52,10 @@ _CACHE_SPEC = P(("data", "fsdp"), "tensor", None, None)
 
 
 def _constrain_cache(cache):
-    return {"k": constrain(cache["k"], _CACHE_SPEC),
-            "v": constrain(cache["v"], _CACHE_SPEC)}
+    # same layout pin for every cache leaf (the int8 form adds per-row
+    # scale arrays [B, Hk, T, 1] — batch/head-sharded exactly like k/v)
+    return {name: constrain(leaf, _CACHE_SPEC)
+            for name, leaf in cache.items()}
 
 
 def _per_layer(stacked, i: int):
@@ -64,7 +66,8 @@ def _num_layers(stacked) -> int:
     return int(jax.tree_util.tree_leaves(stacked)[0].shape[0])
 
 
-def prefill(model, params, prompt, t_max: int, prompt_mask=None):
+def prefill(model, params, prompt, t_max: int, prompt_mask=None,
+            kv_quant: bool = False):
     """Run the prompt through the blocks, filling fresh decode caches.
 
     ``prompt_mask`` (``[B, T0]``, 1 = real token) supports LEFT-padded
@@ -76,7 +79,13 @@ def prefill(model, params, prompt, t_max: int, prompt_mask=None):
 
     Returns ``(last_logits [B, vocab], caches)`` where ``caches`` is a
     list of per-layer ``{"k","v"}: [B, Hk, t_max, hd]`` (prompt K/V
-    written at positions ``0..T0-1``, rest zeros).
+    written at positions ``0..T0-1``, rest zeros). ``kv_quant`` stores
+    the cache in the INT8 form instead
+    (``{"k","v" int8, "k_scale","v_scale" f32}``, per-row scales —
+    halves the decode tick's cache stream; see
+    ``ops/attention.py::cached_attention_q8``). The prefill compute
+    itself is untouched, so the first generated token is exactly the
+    bf16-cache path's.
     """
     B, T0 = prompt.shape
     assert T0 <= t_max, (T0, t_max)
@@ -97,9 +106,22 @@ def prefill(model, params, prompt, t_max: int, prompt_mask=None):
         x = block.apply(_per_layer(params["blocks"], i), x, kv_sink=sink,
                         kv_mask=prompt_mask)
         (k, v), = sink
-        pad = lambda a: lax.dynamic_update_slice_in_dim(
-            jnp.zeros((B, hk, t_max, hd), dtype), a.astype(dtype), 0, axis=2)
-        caches.append(_constrain_cache({"k": pad(k), "v": pad(v)}))
+        if kv_quant:
+            from distributed_compute_pytorch_tpu.utils.quantize import (
+                quantize_kv)
+            pad = lambda a, w, dt: lax.dynamic_update_slice_in_dim(
+                jnp.zeros((B, hk, t_max, w), dt), a, 0, axis=2)
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            caches.append(_constrain_cache(
+                {"k": pad(kq, hd, jnp.int8), "v": pad(vq, hd, jnp.int8),
+                 "k_scale": pad(ks, 1, jnp.float32),
+                 "v_scale": pad(vs, 1, jnp.float32)}))
+        else:
+            pad = lambda a: lax.dynamic_update_slice_in_dim(
+                jnp.zeros((B, hk, t_max, hd), dtype), a.astype(dtype), 0,
+                axis=2)
+            caches.append(_constrain_cache({"k": pad(k), "v": pad(v)}))
     return model.readout(params, x)[:, -1], caches
 
 
@@ -130,7 +152,7 @@ def _sample(logits, temperature: float, rng, top_k: int | None = None,
 def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                      temperature: float = 0.0, eos_id: int | None = None,
                      top_k: int | None = None, top_p: float | None = None,
-                     mesh=None):
+                     mesh=None, kv_quant: bool = False):
     """Build a jitted ``(params, prompt [B, T0], rng) -> tokens
     [B, T0 + max_new_tokens]`` generation function.
 
@@ -191,7 +213,8 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
         B, T0 = prompt.shape
         last_logits, caches = prefill(
             model, params, prompt, _tmax,
-            prompt_mask=prompt_mask if _masked else None)
+            prompt_mask=prompt_mask if _masked else None,
+            kv_quant=kv_quant)
         if _masked:
             pad_count = T0 - jnp.sum(prompt_mask.astype(jnp.int32), axis=1)
             slot_mask = jnp.concatenate(
@@ -308,21 +331,22 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
 
 @lru_cache(maxsize=32)
 def _cached_generate_fn(model, max_new_tokens, t_max, temperature, eos_id,
-                        top_k, top_p, mesh):
+                        top_k, top_p, mesh, kv_quant=False):
     """Memoized builder behind the one-shot :func:`generate` — repeated
     one-shot calls with the same settings reuse one jit cache instead of
     retracing each time (models are frozen dataclasses, so hashable;
     ``Mesh`` is hashable too)."""
     return make_generate_fn(model, max_new_tokens, t_max=t_max,
                             temperature=temperature, eos_id=eos_id,
-                            top_k=top_k, top_p=top_p, mesh=mesh)
+                            top_k=top_k, top_p=top_p, mesh=mesh,
+                            kv_quant=kv_quant)
 
 
 def generate(model, params, prompt, max_new_tokens: int, *,
              t_max: int | None = None, temperature: float = 0.0, rng=None,
              prompt_mask=None, eos_id: int | None = None,
              top_k: int | None = None, top_p: float | None = None,
-             mesh=None):
+             mesh=None, kv_quant: bool = False):
     """One-shot convenience wrapper around :func:`make_generate_fn`.
 
     ``prompt_mask`` (``[B, T0]``, 1 = real) enables LEFT-padded
@@ -333,5 +357,5 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     calls do not retrace.
     """
     return _cached_generate_fn(model, max_new_tokens, t_max, temperature,
-                               eos_id, top_k, top_p, mesh)(
+                               eos_id, top_k, top_p, mesh, kv_quant)(
         params, prompt, rng, prompt_mask=prompt_mask)

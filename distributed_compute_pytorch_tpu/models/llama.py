@@ -196,19 +196,16 @@ class LlamaBlock:
         exact for variable-length batches (``slot_mask`` keeps the pad
         slots unattended).
         """
-        from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
-            cache_insert)
         c = self.config
         d, hd = c.d_model, c.head_dim
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
         h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
         q, k, v = self._qkv(params, h, jnp.atleast_1d(pos))
-        # in-place slot write on TPU — XLA's DUS copies the whole cache
-        # every tick otherwise (see ops/pallas/cache_update.py)
-        cache = {"k": cache_insert(cache["k"], k, pos),
-                 "v": cache_insert(cache["v"], v, pos)}
-        o = A.cached_attention(q, cache["k"], cache["v"], pos,
-                               slot_mask=slot_mask)
+        # in-place slot write on TPU (XLA's DUS copies the whole cache
+        # every tick otherwise) + attention, bf16 or int8 cache format —
+        # see ops/attention.py::cache_write_and_attend
+        o, cache = A.cache_write_and_attend(q, k, v, cache, pos,
+                                            slot_mask=slot_mask)
         x = x + dense(c.num_heads * hd, d).apply(params["o"],
                                                  A.merge_heads(o))
         return self._mlp(params, x), cache

@@ -204,8 +204,6 @@ class TransformerBlock:
         are bidirectional (BERT) and have no autoregressive decode.
         """
         assert self.causal and self.pre_ln, "decode needs a causal pre-LN block"
-        from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
-            cache_insert)
         d = self.d_model
         h = L.LayerNorm(d).apply(params["ln1"], x)
         qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
@@ -213,12 +211,11 @@ class TransformerBlock:
         q = A.split_heads(q, self.num_heads)
         k = A.split_heads(k, self.num_heads)
         v = A.split_heads(v, self.num_heads)
-        # in-place slot write on TPU — XLA's DUS copies the whole cache
-        # every tick otherwise (see ops/pallas/cache_update.py)
-        cache = {"k": cache_insert(cache["k"], k, pos),
-                 "v": cache_insert(cache["v"], v, pos)}
-        o = A.cached_attention(q, cache["k"], cache["v"], pos,
-                               slot_mask=slot_mask)
+        # in-place slot write on TPU (XLA's DUS copies the whole cache
+        # every tick otherwise) + attention, bf16 or int8 cache format —
+        # see ops/attention.py::cache_write_and_attend
+        o, cache = A.cache_write_and_attend(q, k, v, cache, pos,
+                                            slot_mask=slot_mask)
         x = x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o))
         h = L.LayerNorm(d).apply(params["ln2"], x)
         return x + self._mlp(params, h, None, False), cache

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def dot_product_attention(q, k, v, *, causal: bool = False, bias=None,
@@ -162,3 +163,89 @@ def merge_heads(x):
     """``[b, h, t, hd]`` -> ``[b, t, h*hd]``."""
     b, h, t, hd = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
+
+
+def cached_attention_q8(q, cache, pos, *, scale: float | None = None,
+                        slot_mask=None):
+    """:func:`cached_attention` over an INT8-quantized K/V cache.
+
+    ``cache``: ``{"k","v": int8 [B, Hk, T_max, hd],
+    "k_scale","v_scale": f32 [B, Hk, T_max, 1]}`` — per-row symmetric
+    scales (``utils/quantize.py::quantize_kv``). The scales commute out
+    of both contractions, so the int8 arrays enter the dots DIRECTLY
+    (the weight-quantization lesson, ``ops/int8_matmul.py``: a dequant
+    first would materialise a bf16 copy and lose the bandwidth):
+
+    - score_t = (q . k_q_t) * k_scale_t — the K scale is per cache ROW,
+      which is the score's last axis, a plain broadcast multiply;
+    - out = sum_t p_t * v_t = sum_t (p_t * v_scale_t) * v_q_t — the V
+      scale folds into the probability before the value contraction.
+
+    Probabilities are computed in f32 and cast to ``q.dtype`` for the
+    value dot (the measured-fast mixed-dtype pairing is bf16 x int8);
+    that cast is the one extra rounding vs the bf16-cache path and is
+    far below the int8 quantization error itself.
+
+    MEASURED (v5e, 2026-07-31) and NOT the default: unlike the 2-D
+    weight matmuls (``ops/int8_matmul.py``), the BATCHED 4-D mixed
+    dots here do not stream the int8 cache — the full decode tick
+    regresses (llama 0.52 -> 0.99 ms, gpt2 0.97 -> 2.34 with int8
+    weights on). ``--quantize int8-kv`` therefore buys cache MEMORY
+    (half the bytes resident — longer contexts per chip), not speed,
+    on current XLA:TPU; revisit if batched mixed-dot lowering improves.
+    """
+    B, H, q_len, hd = q.shape
+    k_q, v_q = cache["k"], cache["v"]
+    hk = k_q.shape[1]
+    grouped = H != hk
+    if grouped:
+        assert q_len == 1, "GQA cache read expects single-position queries"
+        q = q.reshape(B, hk, (H // hk) * q_len, hd)
+    sc = (hd ** -0.5) if scale is None else scale
+    # [B, hk, g, T]: mixed bf16 x int8 dot over hd, batched over (B, hk)
+    scores = lax.dot_general(
+        q, k_q, dimension_numbers=(((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32) * sc
+    scores = scores * cache["k_scale"][:, :, None, :, 0]
+    valid = (jnp.arange(k_q.shape[2]) <= pos)[None, None, None, :]
+    if slot_mask is not None:
+        valid = jnp.logical_and(valid,
+                                slot_mask[:, None, None, :].astype(bool))
+    # finite fill, not -inf: a fully-masked row (padded query) must give
+    # finite garbage downstream masking absorbs, never NaN — same
+    # convention as dot_product_attention above
+    probs = jax.nn.softmax(jnp.where(valid, scores, -1e30), axis=-1)
+    pv = (probs * cache["v_scale"][:, :, None, :, 0]).astype(q.dtype)
+    out = lax.dot_general(
+        pv, v_q, dimension_numbers=(((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32).astype(q.dtype)
+    return out.reshape(B, H, q_len, hd) if grouped else out
+
+
+def cache_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
+    """One decode tick's cache write + attention, for BOTH cache formats.
+
+    ``cache`` either ``{"k","v"}`` (bf16/f32 rows) or the int8 form
+    ``{"k","v","k_scale","v_scale"}`` (``--quantize …+kv``): the new
+    K/V rows are quantized per row (``utils/quantize.py::quantize_kv``)
+    before the slot write, and attention runs
+    :func:`cached_attention_q8` over the int8 arrays. Returns
+    ``(o, new_cache)``. The shared entry point keeps the two block
+    families' ``decode_step``s format-agnostic.
+    """
+    from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+        cache_insert)
+    if "k_scale" in cache:
+        from distributed_compute_pytorch_tpu.utils.quantize import (
+            quantize_kv)
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        cache = {"k": cache_insert(cache["k"], kq, pos),
+                 "v": cache_insert(cache["v"], vq, pos),
+                 "k_scale": cache_insert(cache["k_scale"], ks, pos),
+                 "v_scale": cache_insert(cache["v_scale"], vs, pos)}
+        return cached_attention_q8(q, cache, pos, slot_mask=slot_mask), cache
+    cache = {"k": cache_insert(cache["k"], k, pos),
+             "v": cache_insert(cache["v"], v, pos)}
+    return cached_attention(q, cache["k"], cache["v"], pos,
+                            slot_mask=slot_mask), cache

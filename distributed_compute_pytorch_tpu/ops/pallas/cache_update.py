@@ -36,11 +36,17 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_WINDOW = 8    # minimal sublane-aligned window on the time axis
+_WINDOW = 8    # minimal sublane-aligned window on the time axis (f32/bf16)
+
+
+def _window(dtype) -> int:
+    """int8 tiles need 32 sublanes (pallas_guide tiling table); the
+    bf16/f32 caches keep the measured 8-slot window."""
+    return 32 if dtype == jnp.int8 else _WINDOW
 
 
 def _insert_kernel(pos_ref, upd_ref, cache_ref, out_ref):
-    r = pos_ref[0] % _WINDOW
+    r = pos_ref[0] % cache_ref.shape[2]
     blk = cache_ref[...]
     slot = lax.broadcasted_iota(jnp.int32, blk.shape, 2)
     out_ref[...] = jnp.where(slot == r, upd_ref[...], blk)
@@ -52,18 +58,19 @@ def cache_insert_pallas(cache, upd, pos, *, interpret: bool = False):
     (cache lengths here are multiples of 128 anyway). ``interpret``
     runs the kernel in the Pallas interpreter (CPU correctness tests)."""
     b, hk, t, hd = cache.shape
-    assert t % _WINDOW == 0, (t,)
+    W = _window(cache.dtype)
+    assert t % W == 0, (t, W)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(1,),
         in_specs=[
             pl.BlockSpec((b, hk, 1, hd), lambda i, pos_ref: (0, 0, 0, 0)),
-            pl.BlockSpec((b, hk, _WINDOW, hd),
-                         lambda i, pos_ref: (0, 0, pos_ref[0] // _WINDOW, 0)),
+            pl.BlockSpec((b, hk, W, hd),
+                         lambda i, pos_ref, W=W: (0, 0, pos_ref[0] // W, 0)),
         ],
-        out_specs=pl.BlockSpec((b, hk, _WINDOW, hd),
-                               lambda i, pos_ref:
-                               (0, 0, pos_ref[0] // _WINDOW, 0)),
+        out_specs=pl.BlockSpec((b, hk, W, hd),
+                               lambda i, pos_ref, W=W:
+                               (0, 0, pos_ref[0] // W, 0)),
     )
     return pl.pallas_call(
         _insert_kernel,
@@ -90,7 +97,7 @@ def cache_insert(cache, upd, pos):
     from distributed_compute_pytorch_tpu.core.mesh import current_mesh
     t = cache.shape[2]
     if (jax.default_backend() == "tpu" and current_mesh() is None
-            and jax.device_count() == 1 and t % _WINDOW == 0):
+            and jax.device_count() == 1 and t % _window(cache.dtype) == 0):
         return cache_insert_pallas(cache, upd, pos)
     return lax.dynamic_update_slice_in_dim(
         cache, upd.astype(cache.dtype), pos, axis=2)

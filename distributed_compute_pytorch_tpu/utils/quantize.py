@@ -38,13 +38,20 @@ def is_quantized(leaf) -> bool:
     return isinstance(leaf, dict) and set(leaf) == {"q", "scale"}
 
 
+def _q8(x, axis: int):
+    """The symmetric-int8 core, one place: per-slice abs-max/127 scale
+    (floored at 1e-12), round, clip to [-127, 127]."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x32), axis=axis, keepdims=True) / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _quantize(w, axis: int):
     """Symmetric int8 over ``axis`` (the contraction axis): scale keeps
     that axis reduced, broadcasting exactly in the dequant."""
-    w32 = w.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(w32), axis=axis, keepdims=True) / 127.0
-    scale = jnp.maximum(scale, 1e-12)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    q, scale = _q8(w, axis)
     # scale carries the SOURCE dtype: the layer hooks dequantise back to
     # it, so an f32 pytree keeps f32 activations (and the cached==full
     # generation exactness) while a bf16 inference tree stays bf16
@@ -77,3 +84,14 @@ def quantize_params_int8(params):
         else:
             out.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def quantize_kv(x):
+    """Per-row symmetric int8 for K/V cache entries.
+
+    ``x [..., hd]`` -> ``(q int8 [..., hd], scale f32 [..., 1])`` with
+    one scale per (batch, head, position) row — the granularity at which
+    the scales commute out of the decode attention's two contractions
+    (``ops/attention.py::cached_attention_q8``).
+    """
+    return _q8(x, axis=-1)

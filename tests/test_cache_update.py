@@ -14,17 +14,22 @@ from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
 
 @pytest.mark.parametrize("pos", [0, 1, 7, 8, 63, 127])
 def test_kernel_matches_dus_every_slot(pos):
-    """Interpreter-mode kernel == DUS at window-edge and interior slots."""
-    B, HK, T, HD = 2, 3, 128, 64
-    cache = jax.random.normal(jax.random.key(0),
-                              (B, HK, T, HD)).astype(jnp.bfloat16)
-    upd = jax.random.normal(jax.random.key(1),
-                            (B, HK, 1, HD)).astype(jnp.bfloat16)
-    ref = lax.dynamic_update_slice_in_dim(cache, upd, pos, axis=2)
-    got = jax.jit(
-        lambda c, u, p: cache_insert_pallas(c, u, p, interpret=True)
-    )(cache, upd, jnp.int32(pos))
-    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    """Interpreter-mode kernel == DUS at window-edge and interior slots,
+    for every cache shape the decode paths write: bf16 K/V (8-slot
+    window), int8 K/V (32-slot window, --quantize int8-kv), and the f32
+    per-row scale arrays (last dim 1)."""
+    for dtype, hd in ((jnp.bfloat16, 64), (jnp.int8, 64),
+                      (jnp.float32, 1)):
+        B, HK, T = 2, 3, 128
+        cache = (jax.random.normal(jax.random.key(0), (B, HK, T, hd)) * 40
+                 ).astype(dtype)
+        upd = (jax.random.normal(jax.random.key(1), (B, HK, 1, hd)) * 40
+               ).astype(dtype)
+        ref = lax.dynamic_update_slice_in_dim(cache, upd, pos, axis=2)
+        got = jax.jit(
+            lambda c, u, p: cache_insert_pallas(c, u, p, interpret=True)
+        )(cache, upd, jnp.int32(pos))
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
 
 def test_dispatcher_falls_back_off_tpu():

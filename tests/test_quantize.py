@@ -15,7 +15,7 @@ from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
 from distributed_compute_pytorch_tpu.models.llama import (
     LlamaConfig, LlamaLM)
 from distributed_compute_pytorch_tpu.utils.quantize import (
-    is_quantized, quantize_params_int8)
+    is_quantized, quantize_kv, quantize_params_int8)
 
 
 def test_quantize_roundtrip_error_bound():
@@ -163,3 +163,68 @@ def test_quantized_generate_under_mesh_matches_single_device(devices8):
     # mesh-keyed fn cache), not just the ambient-context one
     out = np.asarray(generate(model, q_sharded, prompt, 8, mesh=mesh))
     np.testing.assert_array_equal(out, ref)
+
+
+# ------------------------------------------------ int8 KV cache
+
+
+def test_cached_attention_q8_matches_dequant_reference():
+    """The int8-KV attention == dense cached attention over the
+    dequantized cache, for GQA, MHA, and masked-slot cases — the scales
+    commute out of both contractions, so only rounding separates them."""
+    from distributed_compute_pytorch_tpu.ops import attention as A
+
+    B, H, Hk, T, hd = 2, 12, 4, 64, 16
+    pos = 37
+    kf = jax.random.normal(jax.random.key(0), (B, Hk, T, hd))
+    vf = jax.random.normal(jax.random.key(1), (B, Hk, T, hd))
+    kq, ks = quantize_kv(kf)
+    vq, vs = quantize_kv(vf)
+    cache = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+    kd = kq.astype(jnp.float32) * ks
+    vd = vq.astype(jnp.float32) * vs
+    sm = jnp.ones((B, T), bool).at[:, :5].set(False)
+    for q, mask in [
+            (jax.random.normal(jax.random.key(2), (B, H, 1, hd)), None),
+            (jax.random.normal(jax.random.key(2), (B, H, 1, hd)), sm),
+            (jax.random.normal(jax.random.key(3), (B, Hk, 1, hd)), None)]:
+        out = A.cached_attention_q8(q, cache, pos, slot_mask=mask)
+        ref = A.cached_attention(q, kd, vd, pos, slot_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_quantize_kv_roundtrip_bound():
+    x = jax.random.normal(jax.random.key(0), (2, 4, 8, 16)) * 3.0
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (2, 4, 8, 1)
+    err = np.abs(np.asarray(q.astype(jnp.float32) * s - x))
+    np.testing.assert_array_less(err, np.broadcast_to(
+        np.asarray(s) / 2 + 1e-7, x.shape))
+
+
+@pytest.mark.parametrize("name,model", [
+    ("gpt2", GPT2(GPT2Config.tiny())),
+    ("llama", LlamaLM(LlamaConfig.tiny())),
+])
+def test_kv_quant_generate(name, model):
+    """int8-KV generation: prefill compute is untouched so the FIRST
+    generated token equals the full forward's argmax exactly; later
+    tokens run on the quantized cache (lossy by design) — shape, prompt
+    preservation, and first-token exactness are the pinned invariants,
+    plus high agreement with the bf16-cache run at these tiny scales."""
+    from distributed_compute_pytorch_tpu.infer import generate
+    params, _ = model.init(jax.random.key(0))
+    B, T0, N = 2, 8, 8
+    prompt = jax.random.randint(jax.random.key(1), (B, T0), 0, 256)
+    out = generate(model, params, prompt, N, kv_quant=True)
+    assert out.shape == (B, T0 + N)
+    np.testing.assert_array_equal(np.asarray(out[:, :T0]),
+                                  np.asarray(prompt))
+    logits, _ = model.apply(params, {}, prompt, train=False)
+    np.testing.assert_array_equal(
+        np.asarray(out[:, T0]),
+        np.asarray(jnp.argmax(logits[:, -1], -1).astype(out.dtype)))
+    ref = np.asarray(generate(model, params, prompt, N))
+    agree = (np.asarray(out) == ref).mean()
+    assert agree >= 0.8, agree
