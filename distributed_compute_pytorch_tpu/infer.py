@@ -162,6 +162,12 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
     of the fixed-shape output (compiled loops cannot shrink; trim at the
     first eos).
 
+    ``kv_quant``: store the KV cache as int8 with per-row scales —
+    halves the cache's resident bytes (longer contexts per chip), but
+    measured SLOWER per tick on v5e (see
+    ``ops/attention.py::cached_attention_q8``); lossy past the first
+    generated token.
+
     ``mesh``: optional ``jax.sharding.Mesh`` — SHARDED generation. The
     prompt/batch shards over the batch axes (``data``/``fsdp``), the KV
     caches and attention heads over ``tensor`` (GQA: the *kv*-head dim is
@@ -352,9 +358,10 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     ``prompt_mask`` (``[B, T0]``, 1 = real) enables LEFT-padded
     variable-length prompt batches; ``eos_id`` stops rows at that token
     (they pad the fixed-shape tail with it). ``mesh`` enables sharded
-    generation (see :func:`make_generate_fn`). The underlying generation
-    function is memoized on all of these settings, so repeated one-shot
-    calls do not retrace.
+    generation and ``kv_quant`` the int8 KV-cache memory mode (see
+    :func:`make_generate_fn`). The underlying generation function is
+    memoized on all of these settings, so repeated one-shot calls do
+    not retrace.
     """
     return _cached_generate_fn(model, max_new_tokens, t_max, temperature,
                                eos_id, top_k, top_p, mesh, kv_quant)(

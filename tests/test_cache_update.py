@@ -2,6 +2,8 @@
 dynamic_update_slice for every slot, and the dispatcher picks the right
 engine per backend/mesh."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -12,7 +14,7 @@ from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
     cache_insert, cache_insert_pallas)
 
 
-@pytest.mark.parametrize("pos", [0, 1, 7, 8, 63, 127])
+@pytest.mark.parametrize("pos", [0, 1, 7, 8, 32, 63, 96, 127])
 def test_kernel_matches_dus_every_slot(pos):
     """Interpreter-mode kernel == DUS at window-edge and interior slots,
     for every cache shape the decode paths write: bf16 K/V (8-slot
@@ -58,3 +60,24 @@ def test_dispatcher_in_scan_traced_pos():
     for i in range(4):
         assert (out[0, 0, i] == i + 1).all()
     assert (out[0, 0, 4:] == 0).all()
+
+
+@pytest.mark.skipif(os.environ.get("DCP_TEST_TPU") != "1",
+                    reason="real-TPU kernel check (set DCP_TEST_TPU=1)")
+@pytest.mark.parametrize("dtype,hd", [(jnp.bfloat16, 64), (jnp.int8, 64),
+                                      (jnp.float32, 1)])
+def test_kernel_on_tpu_hardware(dtype, hd):
+    """The Mosaic-compiled kernel (not the interpreter) == DUS for every
+    cache shape decode writes — bf16 K/V, int8 K/V (32-slot window),
+    f32 scale rows."""
+    B, HK, T = 2, 3, 128
+    cache = (jax.random.normal(jax.random.key(0), (B, HK, T, hd)) * 40
+             ).astype(dtype)
+    upd = (jax.random.normal(jax.random.key(1), (B, HK, 1, hd)) * 40
+           ).astype(dtype)
+    for pos in (0, 31, 32, 127):
+        ref = lax.dynamic_update_slice_in_dim(cache, upd, pos, axis=2)
+        got = jax.jit(
+            lambda c, u, p: cache_insert_pallas(c, u, p))(
+            cache, upd, jnp.int32(pos))
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
