@@ -733,6 +733,8 @@ def main():
     # ladder vs 0.51 in a fresh process, 5-repeat stable either way)
     dec = _stage(_bench_decode, jax, jnp, np, mesh, n_chips)
     dec_ll = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "llama")
+    dec_q = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "gpt2",
+                   True)
     dec_ll_q = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "llama",
                       True)
     # throughput-serving operating point: 4x the sequences amortise the
@@ -770,6 +772,7 @@ def main():
             "gpt2_eval_bf16_t1024": ev,
             "gpt2_decode_kvcache_bf16": dec,
             "llama_decode_kvcache_gqa_bf16": dec_ll,
+            "gpt2_decode_kvcache_int8": dec_q,
             "llama_decode_kvcache_gqa_int8": dec_ll_q,
             "llama_decode_kvcache_gqa_int8_b64": dec_ll_q64,
             "flash_vs_dense_attention_bf16": attn,
@@ -826,6 +829,7 @@ def main():
             "decode_per_tick_ms": {
                 "gpt2": _pick(dec, "per_tick_ms"),
                 "llama": _pick(dec_ll, "per_tick_ms"),
+                "gpt2_int8": _pick(dec_q, "per_tick_ms"),
                 "llama_int8": _pick(dec_ll_q, "per_tick_ms"),
                 "llama_int8_b64_tok_s": _pick(
                     dec_ll_q64, "decode_tokens_per_sec_per_chip"),
