@@ -44,8 +44,11 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt_path", required=True,
                    help="checkpoint written by dcp-train (v1 file or "
                         "sharded v2 directory)")
-    p.add_argument("--model", default="gpt2", choices=("gpt2", "llama"),
-                   help="causal families only (BERT is bidirectional)")
+    p.add_argument("--model", default="gpt2",
+                   choices=("gpt2", "llama", "moe"),
+                   help="causal families only (BERT is bidirectional); "
+                        "'moe' decodes with per-token argmax routing "
+                        "(models/moe.py::MoEBlock)")
     p.add_argument("--model_preset", default=None)
     p.add_argument("--vocab_size", type=int, default=None)
     p.add_argument("--max_seq_len", type=int, default=None)

