@@ -159,7 +159,13 @@ class LlamaBlock:
         return constrain_activations(x, manual_axes)
 
     def apply(self, params, x, *, rng=None, train: bool = False,
-              kv_mask=None, manual_axes=(), kv_sink=None):
+              kv_mask=None, manual_axes=(), kv_sink=None, positions=None):
+        """``positions`` overrides the rope positions (default
+        ``arange(T)``, seq-ring-offset under a manual region): the
+        serving layer's slot-offset admission prefill (``serve.py``)
+        ropes prompt keys at their ABSOLUTE cache slots so later decode
+        queries — roped at their own slots — see the right position
+        differences."""
         del rng, train    # the Llama recipe has no dropout
         c = self.config
         d, hd = c.d_model, c.head_dim
@@ -167,7 +173,8 @@ class LlamaBlock:
 
         x = self._ssa(x, manual_axes)
         h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
-        pos = self._positions(x.shape[1], tuple(manual_axes))
+        pos = (self._positions(x.shape[1], tuple(manual_axes))
+               if positions is None else positions)
         q, k, v = self._qkv(params, h, pos)
         if kv_sink is not None:
             # prefill capture: post-rope, kv-head width — exactly what the

