@@ -75,6 +75,25 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     """
     strategy = strategy or DataParallel()
     fused_opt = hasattr(tx, "fused_apply")
+    # Interleaved layer STORAGE (parallel/pipeline.py): when the model
+    # wants the Megatron interleaved schedule (virtual_stages > 1) on a
+    # pipe mesh, the live TrainState keeps its blocks permuted into the
+    # strided per-device layout for the whole run — init permutes once,
+    # the steps announce it via `interleaved_layout` so pipeline_blocks
+    # consumes the storage in place, and the per-step cross-pipe
+    # all-to-all re-gather (plus its backward scatter) vanishes from the
+    # compiled program. Checkpoints stay LOGICAL: the trainer converts
+    # at its save/restore boundaries via state_layout_transforms.
+    _v = getattr(getattr(model, "config", None), "virtual_stages", 1)
+    _pipe = (mesh.shape["pipe"] if "pipe" in mesh.axis_names else 1)
+    interleave = (_v > 1 and _pipe > 1)
+    if interleave:
+        from distributed_compute_pytorch_tpu.parallel.pipeline import (
+            interleave_blocks, interleaved_layout)
+        _layout_ctx = lambda: interleaved_layout(_pipe, _v)
+    else:
+        import contextlib
+        _layout_ctx = contextlib.nullcontext
     if fused_opt and not isinstance(strategy, DataParallel):
         # a pallas custom call is opaque to the GSPMD partitioner: under a
         # sharded parameter layout XLA would replicate (all-gather) every
@@ -112,6 +131,13 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
 
     def _init(key) -> TrainState:
         params, model_state = model.init(key)
+        if interleave:
+            # one-time permutation into interleaved storage; tx.init on
+            # the permuted tree means the optimizer state is BORN in the
+            # same layout (momentum rows travel with their params)
+            params = {**params,
+                      "blocks": interleave_blocks(params["blocks"],
+                                                  _pipe, _v)}
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -161,8 +187,10 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                 loss = model.loss_fn(out, y)
                 return loss, new_mstate
 
-        # trace-time mesh context: lets layers (ring attention) find the mesh
-        with use_mesh(mesh):
+        # trace-time mesh context: lets layers (ring attention) find the
+        # mesh; the layout context tells pipeline_blocks the blocks are
+        # stored pre-interleaved (no-op otherwise)
+        with use_mesh(mesh), _layout_ctx():
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
         if fused_opt:
@@ -203,7 +231,7 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         contribution (0.0 for the feeder's wraparound-padded rows), making
         eval exact where the reference double-counts padding.
         """
-        with use_mesh(mesh):
+        with use_mesh(mesh), _layout_ctx():
             out, _ = model.apply(_cast_params(state.params),
                                  state.model_state, _cast(x), train=False)
         if hasattr(model, "eval_metrics"):
@@ -234,3 +262,62 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         return metrics
 
     return init_fn, train_step, eval_step
+
+
+def state_layout_transforms(model, tx, mesh: Mesh):
+    """``(to_logical, to_storage)`` converters between the live training
+    state's layer layout and the persistent LOGICAL layout — or ``None``
+    when they coincide (no interleaved storage in play).
+
+    The trainer calls ``to_logical`` on the state it hands to checkpoint
+    saves and ``to_storage`` on what restore returns, so every artifact
+    on disk keeps logical layer order (generation, interop and
+    cross-layout elastic restores never see the strided storage). Both
+    transforms permute the ``blocks`` subtree of params AND of every
+    params-shaped tree inside the optimizer state
+    (``optax.tree_map_params``), and preserve each leaf's sharding.
+    """
+    v = getattr(getattr(model, "config", None), "virtual_stages", 1)
+    pipe = (mesh.shape["pipe"] if "pipe" in mesh.axis_names else 1)
+    if v <= 1 or pipe <= 1:
+        return None
+    import optax as _optax
+
+    from distributed_compute_pytorch_tpu.parallel.pipeline import (
+        deinterleave_blocks, interleave_blocks)
+
+    _memo: dict = {}
+
+    def _convert(state: TrainState, fn) -> TrainState:
+        def params_fn(p):
+            if not (isinstance(p, dict) and "blocks" in p):
+                return p
+            return {**p, "blocks": fn(p["blocks"], pipe, v)}
+
+        # mask tree marking the blocks leaves, mapped through the
+        # optimizer state so momentum/second-moment rows move with
+        # their params; non-params leaves (counts) pass through
+        mask = jax.tree.map(lambda _: False, state.params)
+        if isinstance(mask, dict) and "blocks" in mask:
+            mask = {**mask, "blocks": jax.tree.map(lambda _: True,
+                                                   mask["blocks"])}
+
+        perm_one = lambda a, m: fn(a, pipe, v) if m else a
+        if fn not in _memo:
+            # built ONCE per direction (a fresh jit closure per save
+            # would retrace the permutation program every checkpoint);
+            # shardings are stable for the life of the run
+            out_shardings = jax.tree.map(lambda a: a.sharding, state)
+            _memo[fn] = jax.jit(
+                lambda s: TrainState(
+                    step=s.step,
+                    params=params_fn(s.params),
+                    model_state=s.model_state,
+                    opt_state=_optax.tree_map_params(tx, perm_one,
+                                                     s.opt_state, mask),
+                    rng=s.rng),
+                out_shardings=out_shardings)
+        return _memo[fn](state)
+
+    return (lambda s: _convert(s, deinterleave_blocks),
+            lambda s: _convert(s, interleave_blocks))

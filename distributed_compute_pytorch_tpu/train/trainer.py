@@ -34,7 +34,8 @@ from distributed_compute_pytorch_tpu.train import checkpoint
 from distributed_compute_pytorch_tpu.train.elastic import (
     ClusterPreemption, Heartbeat, Preempted, PreemptionGuard, restart_count)
 from distributed_compute_pytorch_tpu.train.optim import build_optimizer
-from distributed_compute_pytorch_tpu.train.step import make_step_fns
+from distributed_compute_pytorch_tpu.train.step import (
+    make_step_fns, state_layout_transforms)
 from distributed_compute_pytorch_tpu.utils.logging import MetricLogger, log0
 from distributed_compute_pytorch_tpu.utils.timing import Timer, maybe_profile
 
@@ -115,6 +116,11 @@ class Trainer:
             self.model, self.tx, self.mesh, self.strategy,
             donate=config.donate, compute_dtype=compute_dtype,
             augment=augment)
+        # interleaved-pipeline runs keep the LIVE state's blocks in the
+        # strided storage layout; checkpoints stay logical — these
+        # converters sit at the save/restore boundaries (None otherwise)
+        self._layout = state_layout_transforms(self.model, self.tx,
+                                               self.mesh)
 
         self.state = self.init_fn(jax.random.key(config.seed))
         self.start_epoch = 0
@@ -134,6 +140,10 @@ class Trainer:
             shardings = jax.tree.map(lambda a: a.sharding, self.state)
             self.state = checkpoint.restore(config.ckpt_path, self.state,
                                             shardings=shardings)
+            if self._layout is not None:
+                # checkpoint content is logical; the live state runs in
+                # interleaved storage
+                self.state = self._layout[1](self.state)
             self._resumed = True
             epoch = int(manifest["epoch"])
             step_in_epoch = int(manifest.get("extra", {})
@@ -282,14 +292,19 @@ class Trainer:
         thread), sharded (per-host shard files, no O(params) gather), or
         the default coordinator-written single file."""
         cfg = self.config
+        # persistent layout is always LOGICAL: de-interleave the live
+        # state's blocks first on interleaved-pipeline runs (a fresh
+        # permuted copy — safe to hand to the async writer)
+        state = (self.state if self._layout is None
+                 else self._layout[0](self.state))
         if self.checkpointer is not None:
-            self.checkpointer.save(cfg.ckpt_path, self.state, epoch=epoch,
+            self.checkpointer.save(cfg.ckpt_path, state, epoch=epoch,
                                    extra=extra)
         elif cfg.ckpt_sharded:
-            checkpoint.save_sharded(cfg.ckpt_path, self.state, epoch=epoch,
+            checkpoint.save_sharded(cfg.ckpt_path, state, epoch=epoch,
                                     extra=extra)
         else:
-            checkpoint.save(cfg.ckpt_path, self.state, epoch=epoch,
+            checkpoint.save(cfg.ckpt_path, state, epoch=epoch,
                             extra=extra)
 
     def _finish(self) -> None:

