@@ -520,6 +520,73 @@ def _bench_moe(jax, jnp, np, mesh, n_chips, peak_flops,
     }
 
 
+def _bench_real_mnist(jax, jnp, np, mesh, n_chips):
+    """Real-pixel accuracy rung (VERDICT r4 missing #4): when actual
+    MNIST idx files are present locally (``$DCP_MNIST_DIR`` or ./data —
+    this environment has no egress, so nothing is downloaded), train the
+    reference ConvNet on the real 60k training images for 2 epochs with
+    the reference optimizer stack and record TEST-set accuracy next to
+    throughput — the one observable of ``/root/reference/main.py`` the
+    synthetic stages cannot reproduce. Reference behavior note: the
+    reference evaluates on its TRAIN set (SURVEY §A.1, fixed here) and
+    reaches ~98-99% test accuracy in a couple of epochs at lr 1e-3
+    Adadelta + StepLR(0.7)."""
+    from distributed_compute_pytorch_tpu.core.mesh import batch_sharding
+    from distributed_compute_pytorch_tpu.data.datasets import load_mnist
+    from distributed_compute_pytorch_tpu.data.loader import DeviceFeeder
+    from distributed_compute_pytorch_tpu.models.convnet import ConvNet
+    from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+    from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+    data_dir = os.environ.get("DCP_MNIST_DIR", "./data")
+    try:
+        train = load_mnist(data_dir, "train")
+        test = load_mnist(data_dir, "test")
+    except FileNotFoundError:
+        return {"skipped": f"no MNIST idx files under {data_dir} "
+                           f"(zero-egress environment; set DCP_MNIST_DIR)"}
+
+    B = 128
+    model = ConvNet()
+    tx = build_optimizer("adadelta", lr=1e-3, gamma=0.7,
+                         steps_per_epoch=len(train) // B)
+    init_fn, train_step, eval_step = make_step_fns(model, tx, mesh)
+    state = init_fn(jax.random.key(0))
+    feed = DeviceFeeder(train, mesh, B, shuffle=True)
+    # warm trace+compile OUTSIDE the timed wall (train_step donates its
+    # state, so re-init after the throwaway step)
+    for xw, yw in feed.epoch(0):
+        _s, _m = train_step(state, xw, yw)
+        float(np.asarray(_m["loss"]))
+        break
+    state = init_fn(jax.random.key(0))
+    t0 = time.perf_counter()
+    epochs = 2
+    for ep in range(epochs):
+        for x, y in feed.epoch(ep):
+            state, metrics = train_step(state, x, y)
+    float(np.asarray(metrics["loss"]))     # force completion
+    wall = time.perf_counter() - t0
+
+    eval_feed = DeviceFeeder(test, mesh, B, shuffle=False)
+    acc = None
+    # with_valid: 10000 % 128 != 0, so the feeder's wraparound rows carry
+    # valid=0 and the counts are exact (reference double-counts, §A)
+    for x, y, valid in eval_feed.epoch(0, with_valid=True):
+        acc = eval_step(state, x, y, acc, valid=valid)
+    correct = int(np.asarray(acc["correct"]))
+    count = int(np.asarray(acc["count"]))
+    return {
+        "dataset": "mnist_real_idx", "epochs": epochs, "batch": B,
+        "test_accuracy": round(correct / count, 4),
+        "test_correct": f"{correct}/{count}",
+        "train_samples_per_sec_per_chip":
+            round(epochs * len(train) / wall / n_chips, 1),
+        "note": "reference main.py evaluates on its train set (SURVEY "
+                "§A.1); this rung reports honest TEST accuracy",
+    }
+
+
 def _bench_serve(jax, jnp, np, mesh, n_chips):
     """Continuous batching vs gang-scheduled static batching on ONE
     mixed-length request stream (VERDICT r4 missing #2).
@@ -918,6 +985,7 @@ def main():
     # shave only the attention/embedding sliver
     dec_moe = _stage(_bench_decode, jax, jnp, np, mesh, n_chips, "moe")
     serve = _stage(_bench_serve, jax, jnp, np, mesh, n_chips)
+    real_mnist = _stage(_bench_real_mnist, jax, jnp, np, mesh, n_chips)
     gpt2 = _stage(_bench_gpt2, jax, jnp, np, mesh, n_chips, peak)
     llama = _stage(_bench_llama, jax, jnp, np, mesh, n_chips, peak)
     resnet = _stage(_bench_resnet18, jax, jnp, np, mesh, n_chips, peak)
@@ -954,6 +1022,7 @@ def main():
             "llama_decode_kvcache_gqa_int8_b64": dec_ll_q64,
             "moe_8e_decode_kvcache_bf16": dec_moe,
             "serve_continuous_vs_static_llama_int8": serve,
+            "mnist_real_idx_accuracy": real_mnist,
             "flash_vs_dense_attention_bf16": attn,
             # pipeline parallelism needs >1 device; its bubble is
             # quantified on the faked 8-device mesh in
