@@ -166,17 +166,18 @@ def main():
     c_bytes = 2 * B * hk * t_max * hd * 2 * nl
     row("cache-read", scan_probe(cache_tick, q0, 200), c_bytes)
 
-    # ---- cache insert (the in-place Pallas write), all layers ----
+    # ---- cache insert: the PRODUCTION kv-pair one-window write ----
     from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
-        cache_insert)
-    upd = jax.random.normal(jax.random.key(5), (B, hk, 1, hd), jnp.bfloat16)
+        kv_insert_all)
+    pair = {"kv": jnp.stack([cache["k"], cache["v"]])}
+    upd = {"kv": jax.random.normal(jax.random.key(5),
+                                   (2, B, hk, 1, hd), jnp.bfloat16)}
 
     def insert_tick(c):
         for _ in range(nl):
-            c = {"k": cache_insert(c["k"], upd, 37),
-                 "v": cache_insert(c["v"], upd, 37)}
+            c = kv_insert_all(c, upd, 37)
         return c
-    row("cache-insert", scan_probe(insert_tick, cache, 200),
+    row("cache-insert", scan_probe(insert_tick, pair, 200),
         2 * nl * 2 * B * hk * 8 * hd * 2)
 
     # ---- readout: final norm + vocab matmul ----

@@ -201,16 +201,14 @@ class LlamaBlock:
         scores depend only on position differences, and under left
         padding slot differences equal logical differences, so this is
         exact for variable-length batches (``slot_mask`` keeps the pad
-        slots unattended).
+        slots unattended). The kv-pair cache write is one window DMA
+        (``ops/attention.py::cache_write_and_attend``).
         """
         c = self.config
         d, hd = c.d_model, c.head_dim
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
         h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
         q, k, v = self._qkv(params, h, jnp.atleast_1d(pos))
-        # in-place slot write on TPU (XLA's DUS copies the whole cache
-        # every tick otherwise) + attention, bf16 or int8 cache format —
-        # see ops/attention.py::cache_write_and_attend
         o, cache = A.cache_write_and_attend(q, k, v, cache, pos,
                                             slot_mask=slot_mask)
         x = x + dense(c.num_heads * hd, d).apply(params["o"],

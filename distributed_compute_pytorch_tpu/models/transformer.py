@@ -111,11 +111,12 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
 def attention_decode_tick(params, x, cache, pos, *, num_heads: int,
                           slot_mask=None):
     """The shared attention half of one KV-cached decode tick:
-    ln1 -> fused QKV -> in-place cache write + masked attention
-    (``ops/attention.py::cache_write_and_attend``, bf16 or int8 cache) ->
-    attn_out residual. One implementation for every learned-position
-    causal block (dense GPT-2 and MoE — Llama's tick differs: RMSNorm,
-    RoPE, GQA). Returns ``(x + attn_residual, new_cache)``."""
+    ln1 -> fused QKV -> one-window kv-pair cache write + masked
+    attention (``ops/attention.py::cache_write_and_attend``, bf16 or
+    int8 cache) -> attn_out residual. One implementation for every
+    learned-position causal block (dense GPT-2 and MoE — Llama's tick
+    differs: RMSNorm, RoPE, GQA). Returns ``(x + attn_residual,
+    new_cache)``."""
     d = x.shape[-1]
     h = L.LayerNorm(d).apply(params["ln1"], x)
     qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
@@ -125,7 +126,8 @@ def attention_decode_tick(params, x, cache, pos, *, num_heads: int,
     v = A.split_heads(v, num_heads)
     o, cache = A.cache_write_and_attend(q, k, v, cache, pos,
                                         slot_mask=slot_mask)
-    return x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o)), cache
+    return (x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o)),
+            cache)
 
 
 @dataclass(frozen=True)
@@ -218,10 +220,11 @@ class TransformerBlock:
         This block has no rotary embedding — GPT-2's (possibly per-row)
         learned positions enter through the model's ``embed``.
 
-        Writes this step's K/V into ``cache`` (``{"k","v"}: [B, H, T_max,
-        hd]``) and attends over slots ``0..pos`` (minus ``slot_mask``-
-        invalid pad slots). Pre-LN causal blocks only — post-LN blocks
-        are bidirectional (BERT) and have no autoregressive decode.
+        Writes this step's K/V into ``cache`` (``{"kv": [2, B, H, T_max,
+        hd]}``, one window DMA) and attends over slots ``0..pos`` (minus
+        ``slot_mask``-invalid pad slots). Pre-LN causal blocks only —
+        post-LN blocks are bidirectional (BERT) and have no
+        autoregressive decode.
         """
         assert self.causal and self.pre_ln, "decode needs a causal pre-LN block"
         d = self.d_model

@@ -149,6 +149,13 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
     # 12 MHA layers) but REGRESSES the full decode tick (gpt2 1.07 ->
     # 1.14 ms; the 8x f32 score intermediates break fusion elsewhere) —
     # measured and rejected, don't re-add without end-to-end numbers.
+    # NOTE (measured v5e, r5): DEFERRED-write attention (cache holds
+    # slots < pos, current K/V inline as an appended softmax column, all
+    # layers' rows committed in one end-of-tick stacked launch) was
+    # built and measured-REJECTED: reads preceding the aliased write
+    # cost XLA the in-place update (full cache copy; llama tick 0.559 ->
+    # 0.804 ms). Write-then-attend with the kv-pair kernel is the
+    # measured-fast form (ops/pallas/cache_update.py).
     valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, None, None, :]
     if slot_mask is not None:
         valid = jnp.logical_and(valid,
@@ -230,27 +237,31 @@ def cached_attention_q8(q, cache, pos, *, scale: float | None = None,
 def cache_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
     """One decode tick's cache write + attention, for BOTH cache formats.
 
-    ``cache`` either ``{"k","v"}`` (bf16/f32 rows) or the int8 form
-    ``{"k","v","k_scale","v_scale"}`` (``--quantize …+kv``): the new
-    K/V rows are quantized per row (``utils/quantize.py::quantize_kv``)
-    before the slot write, and attention runs
-    :func:`cached_attention_q8` over the int8 arrays. Returns
-    ``(o, new_cache)``. The shared entry point keeps the two block
+    ``cache`` holds this layer's K/V STACKED as one array —
+    ``{"kv": [2, B, Hk, T_max, hd]}`` (dim 0 = k/v) or the int8 form
+    ``{"kv": int8, "scale": f32 [2, B, Hk, T_max, 1]}`` (``--quantize
+    …+kv``; new rows quantized per row first,
+    ``utils/quantize.py::quantize_kv``). The pair layout is a measured
+    r5 decision: the slot write costs one window DMA instead of two
+    (insert+attend 0.101 vs 0.303 ms/tick at the 12-layer Llama decode
+    shapes — ops/pallas/cache_update.py has the full A/B, including the
+    rejected whole-model-stacked deferred variant). Returns
+    ``(o, new_cache)``. The shared entry point keeps the block
     families' ``decode_step``s format-agnostic.
     """
     from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
-        cache_insert)
-    if "k_scale" in cache:
+        kv_insert_all)
+    if "scale" in cache:
         from distributed_compute_pytorch_tpu.utils.quantize import (
             quantize_kv)
         kq, ks = quantize_kv(k)
         vq, vs = quantize_kv(v)
-        cache = {"k": cache_insert(cache["k"], kq, pos),
-                 "v": cache_insert(cache["v"], vq, pos),
-                 "k_scale": cache_insert(cache["k_scale"], ks, pos),
-                 "v_scale": cache_insert(cache["v_scale"], vs, pos)}
-        return cached_attention_q8(q, cache, pos, slot_mask=slot_mask), cache
-    cache = {"k": cache_insert(cache["k"], k, pos),
-             "v": cache_insert(cache["v"], v, pos)}
-    return cached_attention(q, cache["k"], cache["v"], pos,
+        cache = kv_insert_all(
+            cache, {"kv": jnp.stack([kq, vq]),
+                    "scale": jnp.stack([ks, vs])}, pos)
+        view = {"k": cache["kv"][0], "v": cache["kv"][1],
+                "k_scale": cache["scale"][0], "v_scale": cache["scale"][1]}
+        return cached_attention_q8(q, view, pos, slot_mask=slot_mask), cache
+    cache = kv_insert_all(cache, {"kv": jnp.stack([k, v])}, pos)
+    return cached_attention(q, cache["kv"][0], cache["kv"][1], pos,
                             slot_mask=slot_mask), cache

@@ -84,20 +84,111 @@ def cache_insert_pallas(cache, upd, pos, *, interpret: bool = False):
     )(jnp.atleast_1d(pos).astype(jnp.int32), upd.astype(cache.dtype), cache)
 
 
-def cache_insert(cache, upd, pos):
-    """Dispatcher: the in-place Pallas kernel on an unsharded TPU path,
-    ``dynamic_update_slice`` elsewhere (CPU tests; sharded generation,
-    where a pallas call would defeat the GSPMD layout).
-
-    The sharding caveat is enforced MECHANICALLY: the kernel engages only
-    on a single-device process (next to the no-mesh-context check — a
-    bench caller can batch-shard the prompt over a multi-chip mesh
-    without entering a mesh context, and GSPMD would then have to
-    gather the whole cache into the opaque custom call every tick)."""
+def _pallas_ok(caches: dict, axis: int = 2) -> bool:
+    """Single-chip unsharded TPU with every array's time-axis length
+    window-aligned (the sharding caveat in the module docstring,
+    enforced mechanically). ``axis``: the time axis — 2 for the plain
+    [B, hk, T, w] form, 3 for the kv-pair [2, B, hk, T, w] form. ONE
+    policy for both dispatchers."""
     from distributed_compute_pytorch_tpu.core.mesh import current_mesh
-    t = cache.shape[2]
-    if (jax.default_backend() == "tpu" and current_mesh() is None
-            and jax.device_count() == 1 and t % _window(cache.dtype) == 0):
+    return (jax.default_backend() == "tpu" and current_mesh() is None
+            and jax.device_count() == 1
+            and all(c.shape[axis] % _window(c.dtype) == 0
+                    for c in caches.values()))
+
+
+def cache_insert(cache, upd, pos):
+    """Single-array dispatcher (kept for callers outside the decode tick;
+    the tick itself uses :func:`kv_insert_all` — one window DMA for a
+    layer's whole K/V pair)."""
+    if _pallas_ok({"c": cache}):
         return cache_insert_pallas(cache, upd, pos)
     return lax.dynamic_update_slice_in_dim(
         cache, upd.astype(cache.dtype), pos, axis=2)
+
+
+# ---------------------------------------------------------------------------
+# KV-PAIR insert — one window DMA per layer per tick (r5).
+#
+# Measured on v5e (r5 decomposition + in-situ A/B, 12-layer Llama decode
+# shapes, write-then-attend tick):
+#   - 24 single-array launches (k and v separately): 0.266 ms/tick;
+#   - 12 two-ref launches (k+v fused, two windows):  0.270 ms (no win —
+#     the cost is per WINDOW pipeline, not per launch);
+#   - per-layer K/V stacked as ONE [2, B, hk, T, hd] array, 12 launches
+#     of ONE window each: insert+attend 0.101 ms vs 0.303 for the old
+#     per-array form — the win that actually survives in situ;
+#   - a whole-model [L, 2, ...] stack with ONE deferred end-of-tick
+#     launch measured 0.036 ms in isolation but REGRESSED in situ
+#     (llama tick 0.559 -> 0.804): attention must then read the cache
+#     BEFORE the write (current K/V inline), and with reads preceding
+#     the aliased custom call XLA copies the whole cache — measured-
+#     rejected; write-then-attend with per-layer pairs keeps the alias.
+# ---------------------------------------------------------------------------
+
+
+def _pair_kernel(n: int):
+    """Kernel for ``n`` kv-pair cache arrays ([2, B, hk, W, w] blocks,
+    window axis 3)."""
+    def kernel(pos_ref, *refs):
+        upds, caches, outs = refs[:n], refs[n:2 * n], refs[2 * n:]
+        for u, c, o in zip(upds, caches, outs):
+            r = pos_ref[0] % c.shape[3]
+            blk = c[...]
+            slot = lax.broadcasted_iota(jnp.int32, blk.shape, 3)
+            o[...] = jnp.where(slot == r, u[...], blk)
+    return kernel
+
+
+def kv_insert_pallas(cache: dict, upd: dict, pos, *,
+                     interpret: bool = False) -> dict:
+    """One-launch slot write for one layer's kv-pair cache.
+
+    ``cache``: ``{"kv": [2, B, hk, T, hd]}`` (dim 0 = k/v) or the int8
+    form ``{"kv": int8, "scale": f32 [2, B, hk, T, 1]}`` — mixed dtypes
+    each keep their own window (8 sublanes bf16/f32, 32 int8).
+    ``upd``: same trees with ``T == 1``."""
+    names = sorted(cache)
+    n = len(names)
+    in_specs = [None] * (2 * n)
+    out_specs, out_shapes, aliases = [], [], {}
+    for i, name in enumerate(names):
+        c = cache[name]
+        s, b, hk, t, w = c.shape
+        W = _window(c.dtype)
+        assert t % W == 0, (name, t, W)
+        in_specs[i] = pl.BlockSpec(
+            (s, b, hk, 1, w), lambda g, pos_ref: (0, 0, 0, 0, 0))
+        in_specs[n + i] = pl.BlockSpec(
+            (s, b, hk, W, w),
+            lambda g, pos_ref, W=W: (0, 0, 0, pos_ref[0] // W, 0))
+        out_specs.append(pl.BlockSpec(
+            (s, b, hk, W, w),
+            lambda g, pos_ref, W=W: (0, 0, 0, pos_ref[0] // W, 0)))
+        out_shapes.append(jax.ShapeDtypeStruct(c.shape, c.dtype))
+        aliases[1 + n + i] = i
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=in_specs, out_specs=out_specs)
+    outs = pl.pallas_call(
+        _pair_kernel(n),
+        out_shape=out_shapes,
+        grid_spec=grid_spec,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(jnp.atleast_1d(pos).astype(jnp.int32),
+      *[upd[k].astype(cache[k].dtype) for k in names],
+      *[cache[k] for k in names])
+    return dict(zip(names, outs))
+
+
+def kv_insert_all(cache: dict, upd: dict, pos) -> dict:
+    """Dispatcher for one layer's kv-pair write: the one-window Pallas
+    kernel on an unsharded single-device TPU, per-array
+    ``dynamic_update_slice`` on axis 3 elsewhere (CPU tests; sharded
+    generation, where a pallas call would defeat the GSPMD layout)."""
+    if _pallas_ok(cache, axis=3):
+        return kv_insert_pallas(cache, upd, pos)
+    return {k: lax.dynamic_update_slice_in_dim(
+        cache[k], upd[k].astype(cache[k].dtype), pos, axis=3)
+        for k in cache}

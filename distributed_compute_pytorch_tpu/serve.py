@@ -119,10 +119,12 @@ class ContinuousBatcher:
         floats = [l for l in jax.tree.leaves(params)
                   if jnp.issubdtype(l.dtype, jnp.floating)]
         dtype = floats[0].dtype if floats else jnp.float32
-        self._caches = [
-            {"k": jnp.zeros((slots, hk, t_max, hd), dtype),
-             "v": jnp.zeros((slots, hk, t_max, hd), dtype)}
-            for _ in range(n_layers)]
+        # per-layer KV-PAIR arrays [2(k/v), B, hk, T, hd]: each tick's
+        # slot write is one window DMA per layer
+        # (ops/pallas/cache_update.py::kv_insert_all)
+        self._n_layers = n_layers
+        self._caches = [{"kv": jnp.zeros((2, slots, hk, t_max, hd), dtype)}
+                        for _ in range(n_layers)]
         self._slot_mask = jnp.zeros((slots, t_max), jnp.float32)
         self._cur_tok = jnp.zeros((slots,), jnp.int32)
         self._n_logical = jnp.zeros((slots,), jnp.int32)
@@ -138,8 +140,7 @@ class ContinuousBatcher:
         serve bench; a production recycle loop) run many sessions while
         paying trace+compile once — the jitted pieces are per-instance
         closures, so a new ContinuousBatcher would recompile."""
-        self._caches = [jax.tree.map(jnp.zeros_like, c)
-                        for c in self._caches]
+        self._caches = jax.tree.map(jnp.zeros_like, self._caches)
         self._slot_mask = jnp.zeros_like(self._slot_mask)
         self._cur_tok = jnp.zeros_like(self._cur_tok)
         self._n_logical = jnp.zeros_like(self._n_logical)
@@ -169,7 +170,8 @@ class ContinuousBatcher:
                               0)
         x = model.embed(params, prompt, logical)
         blocks = params["blocks"]
-        for i in range(len(caches)):
+        kvs = []
+        for i in range(self._n_layers):
             p_i = jax.tree.map(lambda a: a[i], blocks)
             sink: list = []
             kw = {"kv_sink": sink, "kv_mask": pmask}
@@ -179,12 +181,13 @@ class ContinuousBatcher:
             if isinstance(x, tuple):   # MoE blocks return (x, aux)
                 x = x[0]
             (k, v), = sink             # [1, hk, Tb, hd]
-            c = caches[i]
-            caches[i] = {
-                "k": lax.dynamic_update_slice(
-                    c["k"], k.astype(c["k"].dtype), (row, 0, off, 0)),
-                "v": lax.dynamic_update_slice(
-                    c["v"], v.astype(c["v"].dtype), (row, 0, off, 0))}
+            kvs.append((k, v))
+        caches = [
+            {"kv": lax.dynamic_update_slice(
+                c["kv"],
+                jnp.stack([k, v]).astype(c["kv"].dtype),  # [2,1,hk,Tb,hd]
+                (0, row, 0, off, 0))}
+            for c, (k, v) in zip(caches, kvs)]
         # row's slot validity: dead before the window, the prompt mask
         # inside it, open for decode after it — overwriting whatever the
         # row's previous occupant left
@@ -201,14 +204,13 @@ class ContinuousBatcher:
         [B, S] greedy tokens and the carried state."""
         model = self.model
         blocks = params["blocks"]
-        n_layers = len(caches)
 
         def tick(carry, i):
             tok, caches, n_log = carry
             p = pos0 + 1 + i               # global slot being written
             x = model.embed(params, tok[:, None], n_log[:, None])
             new_caches = []
-            for li in range(n_layers):
+            for li in range(self._n_layers):
                 p_l = jax.tree.map(lambda a: a[li], blocks)
                 x, c2 = self._block.decode_step(p_l, x, caches[li], p,
                                                 slot_mask=slot_mask)

@@ -11,7 +11,7 @@ import pytest
 from jax import lax
 
 from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
-    cache_insert, cache_insert_pallas)
+    cache_insert, cache_insert_pallas, kv_insert_all, kv_insert_pallas)
 
 
 @pytest.mark.parametrize("pos", [0, 1, 7, 8, 32, 63, 96, 127])
@@ -32,6 +32,48 @@ def test_kernel_matches_dus_every_slot(pos):
             lambda c, u, p: cache_insert_pallas(c, u, p, interpret=True)
         )(cache, upd, jnp.int32(pos))
         np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.parametrize("pos", [0, 7, 31, 32, 96, 127])
+@pytest.mark.parametrize("form", ["bf16", "int8kv"])
+def test_kv_pair_insert_matches_dus(pos, form):
+    """ONE window DMA for a layer's K/V pair (the r5 fix: insert+attend
+    measured 0.101 vs 0.303 ms/tick against per-array launches) ==
+    per-array DUS on axis 3, for both cache forms — including the int8
+    form's MIXED windows (32-slot int8 array + 8-slot f32 scales) in
+    one kernel."""
+    B, HK, T, HD = 2, 3, 128, 64
+    key = jax.random.key(0)
+    if form == "bf16":
+        shapes = {"kv": (HD, jnp.bfloat16)}
+    else:
+        shapes = {"kv": (HD, jnp.int8), "scale": (1, jnp.float32)}
+    cache, upd = {}, {}
+    for i, (name, (hd, dt)) in enumerate(shapes.items()):
+        cache[name] = (jax.random.normal(
+            jax.random.fold_in(key, i), (2, B, HK, T, hd)) * 40
+        ).astype(dt)
+        upd[name] = (jax.random.normal(
+            jax.random.fold_in(key, 100 + i), (2, B, HK, 1, hd)) * 40
+        ).astype(dt)
+    ref = {n: lax.dynamic_update_slice_in_dim(cache[n], upd[n], pos,
+                                              axis=3)
+           for n in cache}
+    got = jax.jit(lambda c, u, p: kv_insert_pallas(
+        c, u, p, interpret=True))(cache, upd, jnp.int32(pos))
+    for n in cache:
+        np.testing.assert_array_equal(np.asarray(ref[n]),
+                                      np.asarray(got[n]), err_msg=n)
+
+
+def test_kv_pair_insert_falls_back_off_tpu():
+    """On CPU the pair dispatcher uses plain DUS."""
+    B, HK, T, HD = 1, 2, 16, 8
+    cache = {"kv": jnp.zeros((2, B, HK, T, HD), jnp.float32)}
+    upd = {"kv": jnp.ones((2, B, HK, 1, HD), jnp.float32)}
+    out = jax.jit(kv_insert_all)(cache, upd, jnp.int32(5))
+    assert float(out["kv"][:, 0, 0, 5].sum()) == 2 * HD
+    assert float(out["kv"].sum()) == 2 * HK * HD
 
 
 def test_dispatcher_falls_back_off_tpu():

@@ -55,7 +55,7 @@ def test_prefill_logits_match_forward(name, model):
     np.testing.assert_allclose(np.asarray(last), np.asarray(ref[:, -1]),
                                rtol=1e-4, atol=1e-5)
     hk, hd = model.kv_cache_spec()
-    assert caches[0]["k"].shape == (2, hk, 16, hd)
+    assert caches[0]["kv"].shape == (2, 2, hk, 16, hd)
 
 
 def test_temperature_sampling_deterministic_per_key():
@@ -402,14 +402,14 @@ def test_mesh_generate_cache_actually_sharded(devices8):
     with use_mesh(mesh):
         _, caches = jax.jit(
             lambda p, t: prefill(model, p, t, 16))(sharded, prompt)
-    k = caches[0]["k"]
-    spec = k.sharding.spec
-    assert spec[0] in ("data", ("data",), ("data", "fsdp")), spec
-    assert spec[1] == "tensor", spec
+    kv = caches[0]["kv"]   # kv-pair [2, B, hk, T, hd]
+    spec = kv.sharding.spec
+    assert spec[1] in ("data", ("data",), ("data", "fsdp")), spec
+    assert spec[2] == "tensor", spec
     # 8-way batch over 4 data shards x 2 kv heads over 2 tensor shards
-    # (tiny llama: head_dim = 64/4 = 16)
-    assert k.addressable_shards[0].data.shape == (2, 1, 16, 16), (
-        k.addressable_shards[0].data.shape)
+    # (tiny llama: head_dim = 64/4 = 16; leading k/v pair dim)
+    assert kv.addressable_shards[0].data.shape == (2, 2, 1, 16, 16), (
+        kv.addressable_shards[0].data.shape)
 
 
 def test_mesh_generate_rejects_indivisible_tensor(devices8):

@@ -77,7 +77,7 @@ def test_prefill_logits_match_forward(name, model):
     np.testing.assert_allclose(np.asarray(last), np.asarray(ref[:, -1]),
                                rtol=1e-4, atol=1e-5)
     hk, hd = model.kv_cache_spec()
-    assert caches[0]["k"].shape == (2, hk, 16, hd)
+    assert caches[0]["kv"].shape == (2, 2, hk, 16, hd)
 
 
 def test_left_padded_batch_matches_individual():
