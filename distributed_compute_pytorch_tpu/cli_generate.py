@@ -39,6 +39,71 @@ def _parse_prompts(s: str) -> list[list[int]]:
     return out
 
 
+def load_model_and_params(model_name: str, preset, vocab_size, max_seq_len,
+                          ckpt_path: str, mesh_spec=None, quantize=None):
+    """Shared ``dcp-generate``/``dcp-serve`` checkpoint loader: build the
+    model from its knobs, restore the params subtree (straight into the
+    mesh layout when ``mesh_spec`` is given — no host-side full copy,
+    which is what lets a bigger-than-one-chip checkpoint load at all),
+    and optionally apply weight-only int8. Returns ``(model, params,
+    mesh)``. One implementation so the two CLIs cannot drift."""
+    import jax
+
+    from distributed_compute_pytorch_tpu.models.registry import build_model
+    from distributed_compute_pytorch_tpu.train.checkpoint import (
+        restore_params)
+
+    kw = {k: v for k, v in (("preset", preset),
+                            ("vocab_size", vocab_size),
+                            ("max_seq_len", max_seq_len))
+          if v is not None}
+    model = build_model(model_name, **kw)
+    # ABSTRACT template: structure/shapes/dtypes only — a concrete init
+    # would materialise the full unsharded model on one device
+    template = jax.eval_shape(lambda k: model.init(k)[0],
+                              jax.random.key(0))
+    mesh = None
+    if mesh_spec is not None:
+        from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+        from distributed_compute_pytorch_tpu.parallel.api import (
+            pick_strategy, tree_shardings)
+        mesh = make_mesh(mesh_spec)
+        shardings = tree_shardings(pick_strategy(mesh, model),
+                                   template, mesh)
+        params = restore_params(ckpt_path, template, shardings)
+    else:
+        params = restore_params(ckpt_path, template)
+    if quantize in ("int8", "int8-kv"):
+        # quantize AFTER the (possibly sharded) restore: the jitted
+        # transform's outputs inherit the restored layout via SPMD, so
+        # q/scale stay sharded exactly where the float kernels were and
+        # the mixed-dtype dots partition like any other dot — sharded
+        # int8 serving composes (pinned by tests/test_quantize.py's mesh
+        # case, bit-equal to the single-device quantized run)
+        from distributed_compute_pytorch_tpu.utils.quantize import (
+            quantize_params_int8)
+        params = jax.jit(quantize_params_int8)(params)
+    return model, params, mesh
+
+
+def check_tokenizer_vocab(tok, model) -> None:
+    """The trainer sizes the model vocab EXACTLY to the tokenizer
+    (``--dataset text``); any mismatch means this is not the training
+    tokenizer and the ids would silently mean different tokens (e.g.
+    forgetting ``--tokenizer`` falls back to 'byte', vocab 259)."""
+    if tok.vocab_size != model.config.vocab_size:
+        raise SystemExit(
+            f"tokenizer vocab ({tok.vocab_size}) != model vocab "
+            f"({model.config.vocab_size}) — pass the --tokenizer "
+            f"the model was trained with")
+
+
+def check_eos(eos_id, vocab: int) -> None:
+    if eos_id is not None and not 0 <= eos_id < vocab:
+        # an unreachable eos would silently never stop anything
+        raise SystemExit(f"--eos_id {eos_id} outside vocab [0, {vocab})")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--ckpt_path", required=True,
@@ -100,44 +165,10 @@ def main(argv=None) -> int:
     import numpy as np
 
     from distributed_compute_pytorch_tpu.infer import generate
-    from distributed_compute_pytorch_tpu.models.registry import build_model
-    from distributed_compute_pytorch_tpu.train.checkpoint import (
-        restore_params)
 
-    kw = {k: v for k, v in (("preset", args.model_preset),
-                            ("vocab_size", args.vocab_size),
-                            ("max_seq_len", args.max_seq_len))
-          if v is not None}
-    model = build_model(args.model, **kw)
-    # ABSTRACT template: structure/shapes/dtypes only — a concrete init
-    # would materialise the full unsharded model on one device, defeating
-    # the sharded-restore path for bigger-than-one-chip checkpoints
-    template = jax.eval_shape(lambda k: model.init(k)[0],
-                              jax.random.key(0))
-    mesh = None
-    if args.mesh is not None:
-        from distributed_compute_pytorch_tpu.core.mesh import make_mesh
-        from distributed_compute_pytorch_tpu.parallel.api import (
-            pick_strategy, tree_shardings)
-        mesh = make_mesh(args.mesh)
-        # restore STRAIGHT into the mesh layout — no host-side full copy,
-        # which is what lets a bigger-than-one-chip checkpoint load at all
-        shardings = tree_shardings(pick_strategy(mesh, model),
-                                   template, mesh)
-        params = restore_params(args.ckpt_path, template, shardings)
-    else:
-        params = restore_params(args.ckpt_path, template)
-
-    if args.quantize in ("int8", "int8-kv"):
-        # quantize AFTER the (possibly sharded) restore: the jitted
-        # transform's outputs inherit the restored layout via SPMD, so
-        # q/scale stay sharded exactly where the float kernels were and
-        # the mixed-dtype dots partition like any other dot — sharded
-        # int8 serving composes (pinned by tests/test_quantize.py's mesh
-        # case, bit-equal to the single-device quantized run)
-        from distributed_compute_pytorch_tpu.utils.quantize import (
-            quantize_params_int8)
-        params = jax.jit(quantize_params_int8)(params)
+    model, params, mesh = load_model_and_params(
+        args.model, args.model_preset, args.vocab_size, args.max_seq_len,
+        args.ckpt_path, mesh_spec=args.mesh, quantize=args.quantize)
 
     tok = None
     if args.text_prompt is not None:
@@ -147,16 +178,7 @@ def main(argv=None) -> int:
         from distributed_compute_pytorch_tpu.data.tokenizer import (
             build_tokenizer)
         tok = build_tokenizer(args.tokenizer)
-        if tok.vocab_size != model.config.vocab_size:
-            # the trainer sizes the model vocab EXACTLY to the tokenizer
-            # (--dataset text); any mismatch means this is not the
-            # training tokenizer and the ids would silently mean
-            # different tokens (e.g. forgetting --tokenizer falls back
-            # to 'byte', vocab 259)
-            raise SystemExit(
-                f"tokenizer vocab ({tok.vocab_size}) != model vocab "
-                f"({model.config.vocab_size}) — pass the --tokenizer "
-                f"the model was trained with")
+        check_tokenizer_vocab(tok, model)
         prompts = [tok.encode(t) for t in args.text_prompt]
         if any(not p for p in prompts):
             raise SystemExit("--text_prompt encodes to zero tokens")
@@ -171,9 +193,7 @@ def main(argv=None) -> int:
     if bad:
         # the embedding gather would CLAMP out-of-range ids silently
         raise SystemExit(f"prompt ids {bad} outside vocab [0, {vocab})")
-    if args.eos_id is not None and not 0 <= args.eos_id < vocab:
-        # an unreachable eos would silently never stop anything
-        raise SystemExit(f"--eos_id {args.eos_id} outside vocab [0, {vocab})")
+    check_eos(args.eos_id, vocab)
     if args.temperature == 0.0 and (args.top_k is not None
                                     or args.top_p is not None):
         # greedy ignores truncation; silence here would mislead

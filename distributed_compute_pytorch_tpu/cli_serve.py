@@ -1,0 +1,178 @@
+"""``dcp-serve`` — continuous-batching batch inference over a request file.
+
+The serving-side companion of ``dcp-generate`` (which compiles one
+fixed-shape batch): this drives ``serve.ContinuousBatcher`` — a fixed
+pool of KV-cache rows decoding in compiled segments while finished rows
+take the next queued request — so a FILE of mixed-length requests runs
+through one statically-shaped program with no per-shape recompiles and
+no padding to the longest request. Every request's output is
+token-identical to what ``dcp-generate`` would produce for it alone
+(``tests/test_serve.py``).
+
+Requests come from ``--requests FILE`` (or ``-`` for stdin), one per
+line, either
+
+    12,7,90                     # token ids; --max_new_tokens applies
+    {"tokens": [12,7,90], "max_new": 16}   # per-request budget
+
+or, with ``--tokenizer``, ``{"text": "..."}`` lines / raw text lines.
+Prints one JSON line per request, in input order: {"prompt": [...],
+"new": [...]} (+ "text" when a tokenizer is given).
+
+Example:
+
+    dcp-serve --ckpt_path ck.npz --model llama --model_preset tiny \\
+        --requests prompts.txt --slots 8 --max_new_tokens 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _read_requests(path: str, tok, default_new: int):
+    lines = (sys.stdin if path == "-" else open(path)).read().splitlines()
+    out = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        text = None
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"requests line {i + 1}: bad JSON ({e})")
+            if "text" in obj:
+                text = obj["text"]
+                ids = None
+            else:
+                ids = obj.get("tokens")
+                if not isinstance(ids, list):
+                    raise SystemExit(f"requests line {i + 1}: need "
+                                     f"'tokens' (list) or 'text'")
+            new = obj.get("max_new", default_new)
+            if not isinstance(new, int) or new < 1:
+                raise SystemExit(f"requests line {i + 1}: max_new must "
+                                 f"be a positive integer, got {new!r}")
+        elif tok is not None:
+            text, ids, new = line, None, default_new
+        else:
+            try:
+                ids = [int(t) for t in line.replace(",", " ").split()]
+            except ValueError:
+                raise SystemExit(
+                    f"requests line {i + 1}: token ids expected (pass "
+                    f"--tokenizer to serve raw text), got {line!r}")
+            new = default_new
+        if text is not None:
+            if tok is None:
+                raise SystemExit(f"requests line {i + 1} is text but no "
+                                 f"--tokenizer was given")
+            ids = tok.encode(text)
+        if not ids:
+            raise SystemExit(f"requests line {i + 1}: empty prompt")
+        out.append((ids, new))
+    if not out:
+        raise SystemExit("no requests")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ckpt_path", required=True)
+    p.add_argument("--model", default="gpt2",
+                   choices=("gpt2", "llama", "moe"))
+    p.add_argument("--model_preset", default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--max_seq_len", type=int, default=None)
+    p.add_argument("--requests", required=True,
+                   help="request file ('-' = stdin), one request per "
+                        "line (see module docstring for formats)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="cache rows decoding concurrently")
+    p.add_argument("--t_max", type=int, default=None,
+                   help="cache length == total tick horizon (default: "
+                        "sized from the workload)")
+    p.add_argument("--prompt_buf", type=int, default=None,
+                   help="static prompt window (default: longest prompt)")
+    p.add_argument("--segment", type=int, default=16,
+                   help="decode ticks per compiled segment")
+    p.add_argument("--max_new_tokens", type=int, default=32,
+                   help="budget for requests that don't carry max_new")
+    p.add_argument("--eos_id", type=int, default=None)
+    p.add_argument("--tokenizer", default=None,
+                   help="'byte' or a tokenizer .json: serve TEXT lines "
+                        "and decode outputs back to text")
+    p.add_argument("--quantize", default=None, choices=("int8",),
+                   help="weight-only int8 serving")
+    p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
+    args = p.parse_args(argv)
+
+    if args.max_new_tokens < 1:
+        raise SystemExit("--max_new_tokens must be >= 1")
+    if args.force_cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from distributed_compute_pytorch_tpu.cli_generate import (
+        check_eos, check_tokenizer_vocab, load_model_and_params)
+    from distributed_compute_pytorch_tpu.serve import (
+        ContinuousBatcher, Request)
+
+    model, params, _ = load_model_and_params(
+        args.model, args.model_preset, args.vocab_size, args.max_seq_len,
+        args.ckpt_path, quantize=args.quantize)
+
+    tok = None
+    if args.tokenizer is not None:
+        from distributed_compute_pytorch_tpu.data.tokenizer import (
+            build_tokenizer)
+        tok = build_tokenizer(args.tokenizer)
+        check_tokenizer_vocab(tok, model)
+        if args.eos_id is None:
+            args.eos_id = tok.eos_id
+    reqs = _read_requests(args.requests, tok, args.max_new_tokens)
+
+    vocab = model.config.vocab_size
+    bad = [t for ids, _ in reqs for t in ids if not 0 <= t < vocab]
+    if bad:
+        raise SystemExit(f"prompt ids {bad[:8]} outside vocab [0, {vocab})")
+    check_eos(args.eos_id, vocab)
+
+    cap = getattr(model.config, "max_seq_len", None)
+    if cap is not None:
+        over = [(ids, n) for ids, n in reqs if len(ids) + n > cap]
+        if over:
+            raise SystemExit(
+                f"{len(over)} request(s) exceed the model's "
+                f"max_seq_len={cap} (prompt+max_new); shrink them")
+    prompt_buf = args.prompt_buf or max(len(ids) for ids, _ in reqs)
+    if args.t_max is None:
+        # horizon: positions are lockstep-global and every compiled
+        # segment advances them by a FULL `segment` regardless of how
+        # many ticks were useful, so the worst case (fully serialized
+        # drain) is per-request segment-rounded budgets, not their raw
+        # sum. Over-provisioning only costs cache memory (slots x t_max
+        # rows); pass --t_max to bound it. The slot horizon may
+        # legitimately exceed the model's max_seq_len — only each row's
+        # LOGICAL positions are capacity-bound (checked above).
+        S = args.segment
+        t_max = prompt_buf + sum(-(-n // S) * S for _, n in reqs) + 2 * S
+    else:
+        t_max = args.t_max
+    cb = ContinuousBatcher(model, params, slots=args.slots, t_max=t_max,
+                           prompt_buf=prompt_buf, segment=args.segment,
+                           eos_id=args.eos_id)
+    outs = cb.serve([Request(list(ids), n) for ids, n in reqs])
+    for (ids, _), new in zip(reqs, outs):
+        rec = {"prompt": ids, "new": new}
+        if tok is not None:
+            rec["text"] = tok.decode(new)
+        print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

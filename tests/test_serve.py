@@ -141,6 +141,59 @@ def test_reset_reuses_compiled_programs():
     assert first == again
 
 
+def test_cli_serve_end_to_end(tmp_path, capsys, devices8):
+    """dcp-train writes a checkpoint; dcp-serve runs a mixed-length
+    request file through the continuous batcher — each output line must
+    equal what dcp-generate produces for that prompt alone."""
+    import json
+
+    from distributed_compute_pytorch_tpu.cli_generate import main as gen_main
+    from distributed_compute_pytorch_tpu.cli_serve import main as serve_main
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    ck = str(tmp_path / "ck.npz")
+    data = synthetic_lm(64, seq_len=16, vocab=256, seed=9)
+    cfg = Config(batch_size=32, lr=1e-3, epochs=1, mesh="data=8",
+                 model="gpt2", model_preset="tiny",
+                 dataset="synthetic-lm", optimizer="adamw", ckpt_path=ck)
+    Trainer(cfg, train_data=data, eval_data=data).fit()
+
+    reqfile = tmp_path / "reqs.txt"
+    reqfile.write_text("5, 9, 12\n"
+                       '{"tokens": [7], "max_new": 3}\n'
+                       "1 2 3 4 5\n")
+    capsys.readouterr()          # drain the trainer's log lines
+    rc = serve_main(["--ckpt_path", ck, "--model", "gpt2",
+                     "--model_preset", "tiny", "--max_seq_len", "16",
+                     "--requests", str(reqfile), "--slots", "2",
+                     "--segment", "3", "--max_new_tokens", "5"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [ln["prompt"] for ln in lines] == [[5, 9, 12], [7],
+                                              [1, 2, 3, 4, 5]]
+    assert len(lines[0]["new"]) == 5 and len(lines[1]["new"]) == 3
+
+    # each request == its standalone dcp-generate output
+    for ln in lines:
+        gen_main(["--ckpt_path", ck, "--model", "gpt2",
+                  "--model_preset", "tiny", "--max_seq_len", "16",
+                  "--prompt", ",".join(map(str, ln["prompt"])),
+                  "--max_new_tokens", str(len(ln["new"]))])
+        solo = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert solo["new"] == ln["new"], (ln["prompt"], solo, ln)
+
+    # malformed request files fail loudly
+    bad = tmp_path / "bad.txt"
+    bad.write_text("not tokens\n")
+    with pytest.raises(SystemExit, match="token ids"):
+        serve_main(["--ckpt_path", ck, "--model", "gpt2",
+                    "--model_preset", "tiny", "--max_seq_len", "16",
+                    "--requests", str(bad)])
+
+
 def test_segment_size_invariance():
     """The segment knob is scheduling, not semantics: outputs are
     identical across segment sizes."""
