@@ -1,0 +1,19 @@
+# Developer/CI entry points. `make tier1` is THE gating command: it is
+# byte-for-byte the tier-1 verify line from ROADMAP.md, so the builder,
+# CI, and a laptop all run the identical suite (CPU backend, slow tests
+# excluded, collection errors tolerated so one broken module can't hide
+# the rest of the signal).
+
+SHELL := /bin/bash
+
+.PHONY: tier1 test bench
+
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# the full suite without the tier-1 harness wrapping (local iteration)
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q
+
+bench:
+	python bench.py

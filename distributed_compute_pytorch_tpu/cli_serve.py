@@ -150,16 +150,15 @@ def main(argv=None) -> int:
                 f"max_seq_len={cap} (prompt+max_new); shrink them")
     prompt_buf = args.prompt_buf or max(len(ids) for ids, _ in reqs)
     if args.t_max is None:
-        # horizon: positions are lockstep-global and every compiled
-        # segment advances them by a FULL `segment` regardless of how
-        # many ticks were useful, so the worst case (fully serialized
-        # drain) is per-request segment-rounded budgets, not their raw
-        # sum. Over-provisioning only costs cache memory (slots x t_max
-        # rows); pass --t_max to bound it. The slot horizon may
+        # horizon: positions are PER ROW (rows recycle in place), so
+        # t_max only needs to bound the single largest request — the
+        # prompt window plus its segment-rounded budget — not the whole
+        # stream's tick total. The batcher rounds up to the Pallas
+        # cache-window multiple itself. The slot horizon may
         # legitimately exceed the model's max_seq_len — only each row's
         # LOGICAL positions are capacity-bound (checked above).
         S = args.segment
-        t_max = prompt_buf + sum(-(-n // S) * S for _, n in reqs) + 2 * S
+        t_max = prompt_buf + max(-(-n // S) * S for _, n in reqs)
     else:
         t_max = args.t_max
     cb = ContinuousBatcher(model, params, slots=args.slots, t_max=t_max,

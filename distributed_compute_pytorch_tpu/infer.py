@@ -27,7 +27,10 @@ Model contract (``gpt2.py``/``llama.py``): ``embed(params, tokens,
 positions)`` (positions may be per-row ``[B, T]``), ``readout(params,
 x)``, ``kv_cache_spec()``, ``_block()`` with ``apply(..., kv_sink=...,
 kv_mask=...)`` and ``decode_step(params, x, cache, pos,
-slot_mask=None)``. Correctness is pinned by ``tests/test_generate.py``:
+slot_mask=None)`` — ``pos`` a scalar here (one-shot generation is
+lockstep) or an int32 ``[B]`` vector (per-row decode positions, the
+serving loop's contract — ``serve.ContinuousBatcher``); every family
+honours both. Correctness is pinned by ``tests/test_generate.py``:
 greedy cached generation must equal a full-forward re-run at every step,
 and a left-padded batch must equal each prompt generated alone.
 """

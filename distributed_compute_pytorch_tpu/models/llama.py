@@ -193,22 +193,31 @@ class LlamaBlock:
 
     def decode_step(self, params, x, cache, pos, slot_mask=None):
         """One KV-cached decode tick: ``x [B, 1, d]`` at cache slot
-        ``pos``.
+        ``pos`` — a scalar (lockstep decode, every row at the same slot)
+        or an int32 ``[B]`` vector (per-row decode, each row at its own
+        slot — the serving loop's contract).
 
         The cache stays at kv-head width ([B, Hk, T_max, hd]) — GQA's
         memory/bandwidth saving — and stores POST-rope keys roped at
-        their SLOT indices. The new query ropes at its slot too: RoPE
-        scores depend only on position differences, and under left
-        padding slot differences equal logical differences, so this is
-        exact for variable-length batches (``slot_mask`` keeps the pad
-        slots unattended). The kv-pair cache write is one window DMA
+        their SLOT indices. The new query ropes at its slot too — under
+        a ``[B]`` pos, at its own ROW's slot (``apply_rope`` takes
+        ``[B, 1]`` positions): RoPE scores depend only on position
+        differences within a row, so absolute-per-row slots are exactly
+        as valid as absolute-shared slots, and under left padding slot
+        differences equal logical differences — exact for
+        variable-length batches (``slot_mask`` keeps the pad slots
+        unattended). The kv-pair cache write is one window DMA per row
         (``ops/attention.py::cache_write_and_attend``).
         """
         c = self.config
         d, hd = c.d_model, c.head_dim
         dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
         h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
-        q, k, v = self._qkv(params, h, jnp.atleast_1d(pos))
+        # scalar pos -> [1] (shared across rows); [B] pos -> [B, 1]
+        # (each row ropes this tick's single token at its own slot)
+        rope_pos = (pos[:, None] if jnp.ndim(pos) == 1
+                    else jnp.atleast_1d(pos))
+        q, k, v = self._qkv(params, h, rope_pos)
         o, cache = A.cache_write_and_attend(q, k, v, cache, pos,
                                             slot_mask=slot_mask)
         x = x + dense(c.num_heads * hd, d).apply(params["o"],

@@ -467,7 +467,8 @@ class MoEBlock:
         return x + y, aux
 
     def decode_step(self, p, x, cache, pos, slot_mask=None):
-        """One KV-cached decode tick, ``x [B, 1, d]`` at slot ``pos``:
+        """One KV-cached decode tick, ``x [B, 1, d]`` at slot ``pos``
+        (scalar, or ``[B]`` for per-row decode positions):
         the shared attention tick (``transformer.attention_decode_tick``)
         plus the tick's B tokens routed as one full-capacity group
         through the experts (no live token ever drops — class

@@ -113,10 +113,12 @@ def attention_decode_tick(params, x, cache, pos, *, num_heads: int,
     """The shared attention half of one KV-cached decode tick:
     ln1 -> fused QKV -> one-window kv-pair cache write + masked
     attention (``ops/attention.py::cache_write_and_attend``, bf16 or
-    int8 cache) -> attn_out residual. One implementation for every
-    learned-position causal block (dense GPT-2 and MoE — Llama's tick
-    differs: RMSNorm, RoPE, GQA). Returns ``(x + attn_residual,
-    new_cache)``."""
+    int8 cache) -> attn_out residual. ``pos`` is a scalar (lockstep
+    decode) or an int32 ``[B]`` vector (per-row decode — every row
+    writes and attends at its own slot; the serving loop's contract).
+    One implementation for every learned-position causal block (dense
+    GPT-2 and MoE — Llama's tick differs: RMSNorm, RoPE, GQA). Returns
+    ``(x + attn_residual, new_cache)``."""
     d = x.shape[-1]
     h = L.LayerNorm(d).apply(params["ln1"], x)
     qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
@@ -215,7 +217,8 @@ class TransformerBlock:
         return x
 
     def decode_step(self, params, x, cache, pos, slot_mask=None):
-        """One KV-cached decode tick: ``x [B, 1, d]`` at position ``pos``.
+        """One KV-cached decode tick: ``x [B, 1, d]`` at position ``pos``
+        (scalar, or ``[B]`` for per-row decode positions).
 
         This block has no rotary embedding — GPT-2's (possibly per-row)
         learned positions enter through the model's ``embed``.

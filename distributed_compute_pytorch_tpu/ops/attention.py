@@ -115,6 +115,18 @@ def attention(q, k, v, *, causal: bool = False, scale: float | None = None,
                                  mask=mask)
 
 
+def _pos_valid_mask(pos, t_max: int):
+    """``[B or 1, 1, 1, T]`` bool mask of cache slots at-or-before
+    ``pos`` — scalar ``pos`` (lockstep decode, one shared write position)
+    or ``[B]`` vector (per-row decode, every row at its own position —
+    the serving loop's contract, ``serve.ContinuousBatcher``)."""
+    pos = jnp.asarray(pos)
+    slots = jnp.arange(t_max)
+    if pos.ndim:
+        return slots[None, None, None, :] <= pos[:, None, None, None]
+    return (slots <= pos)[None, None, None, :]
+
+
 def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
                      slot_mask=None):
     """Single-position decode attention over a preallocated K/V cache.
@@ -126,7 +138,9 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
         ``H`` (GQA) — heads are repeated here, on the read path, so the
         cache itself stays at kv-head width (the whole point of GQA:
         cache memory and bandwidth scale with ``Hk``).
-      pos: scalar position of ``q``; cache slots beyond it are masked.
+      pos: position of ``q`` — a scalar (lockstep: all rows share one
+        position) or an int32 ``[B]`` vector (per-row decode); each
+        row's cache slots beyond its position are masked.
       slot_mask: optional ``[B, T_max]`` per-row slot validity (0/1 or
         bool) — left-padded variable-length prompts leave pad slots in
         the cache, which must never be attended.
@@ -156,7 +170,7 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
     # cost XLA the in-place update (full cache copy; llama tick 0.559 ->
     # 0.804 ms). Write-then-attend with the kv-pair kernel is the
     # measured-fast form (ops/pallas/cache_update.py).
-    valid = (jnp.arange(k_cache.shape[2]) <= pos)[None, None, None, :]
+    valid = _pos_valid_mask(pos, k_cache.shape[2])
     if slot_mask is not None:
         valid = jnp.logical_and(valid,
                                 slot_mask[:, None, None, :].astype(bool))
@@ -219,7 +233,7 @@ def cached_attention_q8(q, cache, pos, *, scale: float | None = None,
         q, k_q, dimension_numbers=(((3,), (3,)), ((0, 1), (0, 1))),
         preferred_element_type=jnp.float32) * sc
     scores = scores * cache["k_scale"][:, :, None, :, 0]
-    valid = (jnp.arange(k_q.shape[2]) <= pos)[None, None, None, :]
+    valid = _pos_valid_mask(pos, k_q.shape[2])
     if slot_mask is not None:
         valid = jnp.logical_and(valid,
                                 slot_mask[:, None, None, :].astype(bool))
@@ -236,6 +250,11 @@ def cached_attention_q8(q, cache, pos, *, scale: float | None = None,
 
 def cache_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
     """One decode tick's cache write + attention, for BOTH cache formats.
+
+    ``pos`` is a scalar (lockstep decode) or an int32 ``[B]`` vector
+    (per-row decode — ``serve.ContinuousBatcher``): each row writes its
+    K/V at, and attends up to, its OWN slot
+    (``ops/pallas/cache_update.py::kv_insert_rows_pallas``).
 
     ``cache`` holds this layer's K/V STACKED as one array —
     ``{"kv": [2, B, Hk, T_max, hd]}`` (dim 0 = k/v) or the int8 form

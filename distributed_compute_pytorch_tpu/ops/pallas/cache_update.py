@@ -183,12 +183,94 @@ def kv_insert_pallas(cache: dict, upd: dict, pos, *,
 
 
 def kv_insert_all(cache: dict, upd: dict, pos) -> dict:
-    """Dispatcher for one layer's kv-pair write: the one-window Pallas
-    kernel on an unsharded single-device TPU, per-array
-    ``dynamic_update_slice`` on axis 3 elsewhere (CPU tests; sharded
-    generation, where a pallas call would defeat the GSPMD layout)."""
+    """Dispatcher for one layer's kv-pair write.
+
+    ``pos`` is either a scalar (lockstep decode: every row writes the
+    same slot — ``infer.py``) or a ``[B]`` int32 vector (per-row decode:
+    each row writes its OWN slot — ``serve.ContinuousBatcher``). Both
+    forms use a one-window-per-row Pallas kernel on an unsharded
+    single-device TPU and per-array ``dynamic_update_slice`` (scalar) /
+    a masked select (vector) elsewhere (CPU tests; sharded generation,
+    where a pallas call would defeat the GSPMD layout)."""
+    if jnp.ndim(pos) == 0:
+        if _pallas_ok(cache, axis=3):
+            return kv_insert_pallas(cache, upd, pos)
+        return {k: lax.dynamic_update_slice_in_dim(
+            cache[k], upd[k].astype(cache[k].dtype), pos, axis=3)
+            for k in cache}
     if _pallas_ok(cache, axis=3):
-        return kv_insert_pallas(cache, upd, pos)
-    return {k: lax.dynamic_update_slice_in_dim(
-        cache[k], upd[k].astype(cache[k].dtype), pos, axis=3)
-        for k in cache}
+        return kv_insert_rows_pallas(cache, upd, pos)
+    return {k: _rowwise_select(cache[k], upd[k], pos) for k in cache}
+
+
+def _rowwise_select(cache, upd, pos):
+    """Vector-position fallback: ``cache [s, B, hk, T, w]`` takes
+    ``upd [s, B, hk, 1, w]`` at per-row slot ``pos [B]``. A full-array
+    select — same cost class as the scalar path's DUS fallback (XLA
+    copies the cache either way off the Pallas path)."""
+    hit = jnp.arange(cache.shape[3])[None, :] == pos[:, None]   # [B, T]
+    return jnp.where(hit[None, :, None, :, None],
+                     upd.astype(cache.dtype), cache)
+
+
+def _pair_rows_kernel(n: int):
+    """Per-row variant of :func:`_pair_kernel`: grid step ``b`` owns
+    batch row ``b``'s window block ([2, 1, hk, W, w], window axis 3) at
+    that row's own position."""
+    def kernel(pos_ref, *refs):
+        b = pl.program_id(0)
+        upds, caches, outs = refs[:n], refs[n:2 * n], refs[2 * n:]
+        for u, c, o in zip(upds, caches, outs):
+            r = pos_ref[b] % c.shape[3]
+            blk = c[...]
+            slot = lax.broadcasted_iota(jnp.int32, blk.shape, 3)
+            o[...] = jnp.where(slot == r, u[...], blk)
+    return kernel
+
+
+def kv_insert_rows_pallas(cache: dict, upd: dict, pos, *,
+                          interpret: bool = False) -> dict:
+    """Per-row slot write for one layer's kv-pair cache: row ``b`` takes
+    its update at ITS OWN slot ``pos[b]`` — the kernel that frees the
+    serving loop from the lockstep-horizon invariant.
+
+    Same trees as :func:`kv_insert_pallas` (``{"kv": [2, B, hk, T, hd]}``
+    or the int8 ``{"kv", "scale"}`` form), ``pos`` an int32 ``[B]``
+    vector. The grid runs one step per batch row; each step DMAs only
+    that row's W-slot window (scalar-prefetched ``pos[b]`` picks the
+    block), overwrites slot ``pos[b] % W`` and DMAs it back — the same
+    total window traffic as the lockstep kernel, split into per-row
+    blocks, with every untouched block aliased in place."""
+    names = sorted(cache)
+    n = len(names)
+    B = cache[names[0]].shape[1]
+    in_specs = [None] * (2 * n)
+    out_specs, out_shapes, aliases = [], [], {}
+    for i, name in enumerate(names):
+        c = cache[name]
+        s, b, hk, t, w = c.shape
+        W = _window(c.dtype)
+        assert t % W == 0, (name, t, W)
+        in_specs[i] = pl.BlockSpec(
+            (s, 1, hk, 1, w), lambda g, pos_ref: (0, g, 0, 0, 0))
+        in_specs[n + i] = pl.BlockSpec(
+            (s, 1, hk, W, w),
+            lambda g, pos_ref, W=W: (0, g, 0, pos_ref[g] // W, 0))
+        out_specs.append(pl.BlockSpec(
+            (s, 1, hk, W, w),
+            lambda g, pos_ref, W=W: (0, g, 0, pos_ref[g] // W, 0)))
+        out_shapes.append(jax.ShapeDtypeStruct(c.shape, c.dtype))
+        aliases[1 + n + i] = i
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(B,),
+        in_specs=in_specs, out_specs=out_specs)
+    outs = pl.pallas_call(
+        _pair_rows_kernel(n),
+        out_shape=out_shapes,
+        grid_spec=grid_spec,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(pos.astype(jnp.int32),
+      *[upd[k].astype(cache[k].dtype) for k in names],
+      *[cache[k] for k in names])
+    return dict(zip(names, outs))

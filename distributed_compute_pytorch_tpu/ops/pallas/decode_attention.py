@@ -60,9 +60,10 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, out_ref, *, block_pairs: int,
     b = pl.program_id(0)
     # clamp: ``pos`` is traced, so a caller off-by-one (pos == T) must
     # degrade like the dense path's mask instead of DMA-reading past the
-    # cache buffer
+    # cache buffer. pos_ref is per-row [B]: grid step b streams only up
+    # to ITS row's valid length (scalar pos broadcasts in the wrapper).
     total_pairs = k_hbm.shape[2]
-    pos = jnp.minimum(pos_ref[0], total_pairs * 2 - 1)
+    pos = jnp.minimum(pos_ref[b], total_pairs * 2 - 1)
     # pairs-per-block loop bound: block covering slot ``pos`` included
     nb = (pos // 2) // block_pairs + 1
     G = q_ref.shape[2]
@@ -176,10 +177,14 @@ def decode_attention_pallas(q, k_cache, v_cache, pos, *,
                             scale: float | None = None,
                             block_k: int = 128):
     """``q [B, Hk, G, hd]`` (grouped query rows), caches
-    ``[B, Hk, T, hd]``; attends slots ``0..pos``. Returns
-    ``[B, Hk, G, hd]`` in q's dtype. ``hd`` must be 64 (the packed-lane
-    layout; the framework's decode models all use 64) and ``T`` must be
-    divisible by ``block_k`` (cache lengths are multiples of 128)."""
+    ``[B, Hk, T, hd]``; attends slots ``0..pos``. ``pos`` is a scalar
+    (every row at the same position) or an int32 ``[B]`` vector (per-row
+    valid lengths — the serving loop's per-row decode positions); each
+    grid step streams only its row's ``pos[b] // block_k + 1`` blocks.
+    Returns ``[B, Hk, G, hd]`` in q's dtype. ``hd`` must be 64 (the
+    packed-lane layout; the framework's decode models all use 64) and
+    ``T`` must be divisible by ``block_k`` (cache lengths are multiples
+    of 128)."""
     B, Hk, G, hd = q.shape
     T = k_cache.shape[2]
     assert hd == 64, hd
@@ -198,9 +203,10 @@ def decode_attention_pallas(q, k_cache, v_cache, pos, *,
         ],
         out_specs=pl.BlockSpec((1, Hk, G, hd), lambda b, p: (b, 0, 0, 0)),
     )
+    pos = jnp.broadcast_to(jnp.atleast_1d(pos).astype(jnp.int32), (B,))
     return pl.pallas_call(
         functools.partial(_kernel, block_pairs=block_pairs, scale=scale,
                           num_heads=Hk),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid_spec=grid_spec,
-    )(jnp.atleast_1d(pos).astype(jnp.int32), q, kp, vp)
+    )(pos, q, kp, vp)

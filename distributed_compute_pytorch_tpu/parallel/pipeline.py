@@ -53,6 +53,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_compute_pytorch_tpu.core.mesh import (
+    pcast_varying as _pcast_varying)
+
 
 # ---------------------------------------------------------------------------
 # Interleaved layer STORAGE (VERDICT r4 missing #3).
@@ -467,8 +470,7 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         # aux carry must be typed varying like h (it mixes with per-layer
         # aux derived from varying activations)
         acc0 = jax.tree.map(
-            lambda a: lax.pcast(jnp.zeros((), jnp.float32), manual,
-                                to="varying"),
+            lambda a: _pcast_varying(jnp.zeros((), jnp.float32), manual),
             aux_init) if with_aux else ()
         (h, acc), _ = lax.scan(layer_body, (h, acc0),
                                (jnp.arange(n_run), params_slice))
@@ -489,7 +491,10 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
     out_specs = ((x_spec, jax.tree.map(lambda _: P(), aux_init))
                  if with_aux else x_spec)
 
-    @partial(jax.shard_map, mesh=mesh,
+    from distributed_compute_pytorch_tpu.core.mesh import (
+        shard_map as _shard_map)
+
+    @partial(_shard_map, mesh=mesh,
              in_specs=in_specs, out_specs=out_specs,
              axis_names=set(manual))
     def _pipe(params_local, x_mb, *maybe_mask):
@@ -499,14 +504,11 @@ def pipeline_blocks(block_apply, stacked_params, x, mesh: Mesh,
         stage = lax.axis_index(axis)
         # fresh zeros (NOT zeros_like: that inherits x_mb's varying-over-seq
         # type, and pcast rejects mixed varying/invarying inputs)
-        state = lax.pcast(jnp.zeros(x_mb.shape[1:], x_mb.dtype), manual,
-                          to="varying")
-        outputs = lax.pcast(jnp.zeros(x_mb.shape, x_mb.dtype), manual,
-                            to="varying")
+        state = _pcast_varying(jnp.zeros(x_mb.shape[1:], x_mb.dtype), manual)
+        outputs = _pcast_varying(jnp.zeros(x_mb.shape, x_mb.dtype), manual)
 
         aux_acc = jax.tree.map(
-            lambda a: lax.pcast(jnp.zeros((), jnp.float32), manual,
-                                to="varying"),
+            lambda a: _pcast_varying(jnp.zeros((), jnp.float32), manual),
             aux_init) if with_aux else ()
 
         def tick(carry, t):

@@ -13,42 +13,66 @@ instead, with everything the TPU touches remaining static-shaped:
   per block, in-place cache writes, greedy sample). Caches/tokens carry
   ACROSS calls as donated buffers, so consecutive segments reuse the
   same compiled program at zero re-trace cost.
-- **Left-aligned admission**: between segments, finished rows take new
-  prompts. The new prompt — all tokens but its last, padded into a fixed
-  ``prompt_buf`` window — is prefilled so its final prefilled token
-  lands at the pool's current global position; the LAST prompt token
-  becomes the row's current token, consumed by the next segment's first
-  tick exactly as standalone generation would (and keeping admission
-  fetch-free — see ``_admit_impl``). Every row thus shares one scalar
-  write position — the lockstep invariant the whole cache machinery
-  (single ``pos``, in-place Pallas slot write) is built on — while
-  per-row ``slot_mask`` rows hide the pad slots and everything the
-  row's previous occupant left behind.
-  Positions stay exact per family: learned-position models embed LOGICAL
-  positions (0..n-1 per row), rope models rope at ABSOLUTE slots (the
-  ``positions`` override in ``LlamaBlock.apply``), and RoPE scores
-  depend only on slot differences, which left alignment preserves.
+- **Per-row positions**: every cache row advances an INDEPENDENT write
+  position (``decode_step`` takes a ``[B]`` position vector; the Pallas
+  slot write is per-row — ``ops/pallas/cache_update.py::
+  kv_insert_rows_pallas`` — and decode attention masks each row at its
+  own valid length). Admission writes a new prompt at the ROW'S OWN
+  window ``[0, prompt_buf)`` — no global position to align to, no
+  shared ``prompt_buf`` burn — and rewinds that row to slot
+  ``prompt_buf - 1``. ``t_max`` is therefore a PER-REQUEST length
+  bound, not a session-wide tick budget: rows recycle indefinitely on
+  the same compiled programs and a session never exhausts. (The
+  previous design kept one global lockstep position, which made
+  ``t_max`` a shared horizon that every admission and every tick
+  drained — mixed-length streams collapsed cache utilization and
+  ``serve`` could raise mid-run, discarding finished work.)
+- **Admission**: a finished row takes the next queued prompt. The new
+  prompt — all tokens but its last, left-padded into the fixed
+  ``prompt_buf`` window at the row's offset 0 — is prefilled; the LAST
+  prompt token becomes the row's current token, consumed by the next
+  segment's first tick at slot ``prompt_buf`` exactly as standalone
+  generation would (and keeping admission fetch-free — see
+  ``_admit_impl``). Per-row ``slot_mask``
+  rows hide the pad slots; the per-row position mask hides everything
+  the row's previous occupant left beyond the live position.
+  Positions stay exact per family: learned-position models embed
+  LOGICAL positions (0..n-1 per row), rope models rope at ABSOLUTE
+  PER-ROW slots (the ``positions`` override in ``LlamaBlock.apply`` at
+  admission, the ``[B]`` pos vector at decode), and RoPE scores depend
+  only on within-row slot differences, which the fixed window offset
+  preserves.
 - **Host scheduler**: a plain queue. It admits into free rows, runs a
   segment, harvests each row's tokens (trimming at eos/budget), and
   re-admits — requests at MIXED lengths stream through a statically
-  shaped program with no bucketing and no recompilation.
+  shaped program with no bucketing, no recompilation, and no session
+  horizon.
 
-The horizon is the cache: ``t_max`` slots bound the total ticks of one
-session (every admission consumes ``prompt_buf`` slots once plus one
-slot per generated token, shared globally since positions are lockstep).
-A production server would recycle by re-prefilling still-active rows
-into a fresh session at horizon's end; here the caller sizes ``t_max``
-for the workload and ``serve`` raises when it would overrun.
+The horizon is per request: a row admitted with budget ``max_new``
+ticks at most ``ceil(max_new / segment) * segment`` times before it is
+harvested and freed, so admission requires ``prompt_buf +
+ceil(max_new/segment)*segment <= t_max``. A request that can NEVER
+satisfy that bound is not admitted; ``serve`` completes everything
+else and then raises :class:`HorizonError` CARRYING the completed
+outputs (``.outputs``) instead of discarding finished work.
 
 Correctness contract (``tests/test_serve.py``): greedy-served outputs of
 staggered admissions equal each prompt's standalone ``infer.generate``,
 token for token, for GPT-2 (learned positions), Llama (RoPE/GQA) and the
-MoE family (inference routing).
+MoE family (inference routing). MoE no-drop precondition: admission
+prefills one row over the fixed ``prompt_buf`` window, so its expert
+capacity ``C = ceil(ecf * top_k * N / E)`` is derived from
+``prompt_buf`` — NOT from the prompt's real token count the standalone
+path sees. The two paths therefore agree token-for-token only while
+eval capacity never binds (no token is capacity-dropped on either
+path); size ``eval_capacity_factor`` for the no-drop regime when
+serving MoE models.
 """
 
 from __future__ import annotations
 
 import inspect
+import warnings
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -76,6 +100,19 @@ class _Slot:
     out: list = field(default_factory=list)
 
 
+class HorizonError(RuntimeError):
+    """A request's segment-rounded budget can never fit the per-row
+    horizon (``prompt_buf + ceil(max_new/segment)*segment > t_max``).
+
+    Raised AFTER every admissible request has been served; ``outputs``
+    holds the completed results (in request order, ``[]`` for the
+    rejected requests) so finished work is never discarded."""
+
+    def __init__(self, message: str, outputs: list):
+        super().__init__(message)
+        self.outputs = outputs
+
+
 class ContinuousBatcher:
     """Fixed-pool continuous batching for one causal LM.
 
@@ -83,7 +120,15 @@ class ContinuousBatcher:
       model: any ``infer.py``-contract model (GPT-2 / Llama / MoE).
       params: its (possibly quantized) parameters.
       slots: cache rows decoding concurrently (the static batch).
-      t_max: cache length == the session's total tick horizon.
+      t_max: cache length == each ROW's length bound: one request needs
+        ``prompt_buf + ceil(max_new/segment)*segment <= t_max``. Rounded
+        up to the Pallas cache-window multiple (8 for bf16/f32 caches,
+        32 for int8 — ``ops/pallas/cache_update.py::_window``), exactly
+        as ``infer.make_generate_fn`` does: a misaligned length would
+        silently drop every tick onto the ~3x-slower full-cache-copy
+        ``dynamic_update_slice`` path, and the extra slots are never
+        attended (the per-row position mask stops at each row's live
+        position), so rounding up is observationally free.
       prompt_buf: static prompt window; prompts longer than this are
         rejected (size it to the workload's longest prompt).
       segment: ticks per compiled decode call. Smaller = finer admission
@@ -96,12 +141,13 @@ class ContinuousBatcher:
     def __init__(self, model, params, *, slots: int, t_max: int,
                  prompt_buf: int, segment: int = 16,
                  eos_id: int | None = None):
+        from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+            _pallas_ok, _window)
         if prompt_buf > t_max:
             raise ValueError(f"prompt_buf {prompt_buf} > t_max {t_max}")
         self.model = model
         self.params = params
         self.B = slots
-        self.t_max = t_max
         self.Tb = prompt_buf
         self.S = segment
         self.eos_id = eos_id
@@ -119,16 +165,32 @@ class ContinuousBatcher:
         floats = [l for l in jax.tree.leaves(params)
                   if jnp.issubdtype(l.dtype, jnp.floating)]
         dtype = floats[0].dtype if floats else jnp.float32
+        # ADVICE r5: align t_max to the in-place Pallas slot write's
+        # window so serving never silently falls off the fast path
+        align = _window(dtype)
+        self.t_max = -(-t_max // align) * align
         # per-layer KV-PAIR arrays [2(k/v), B, hk, T, hd]: each tick's
-        # slot write is one window DMA per layer
-        # (ops/pallas/cache_update.py::kv_insert_all)
+        # slot write is one window DMA per row per layer
+        # (ops/pallas/cache_update.py::kv_insert_rows_pallas)
         self._n_layers = n_layers
-        self._caches = [{"kv": jnp.zeros((2, slots, hk, t_max, hd), dtype)}
+        self._caches = [{"kv": jnp.zeros((2, slots, hk, self.t_max, hd),
+                                         dtype)}
                         for _ in range(n_layers)]
-        self._slot_mask = jnp.zeros((slots, t_max), jnp.float32)
+        if (jax.default_backend() == "tpu"
+                and not _pallas_ok(self._caches[0], axis=3)):
+            warnings.warn(
+                "serving caches fall off the Pallas window-write fast "
+                "path (mesh active, multi-device, or a non-window-"
+                "aligned shape): every decode tick will pay the full-"
+                "cache-copy dynamic_update_slice (~3x slower measured)",
+                stacklevel=2)
+        self._slot_mask = jnp.zeros((slots, self.t_max), jnp.float32)
         self._cur_tok = jnp.zeros((slots,), jnp.int32)
         self._n_logical = jnp.zeros((slots,), jnp.int32)
-        self.pos = prompt_buf - 1   # slot of the last written token
+        # per-row slot of the last written token (host-tracked: admission
+        # rewinds a row to Tb-1, each segment advances every row by S)
+        self._row_pos = [prompt_buf - 1] * slots
+        self.ticks = 0             # decode ticks run this session
         self._admit_c = jax.jit(self._admit_impl,
                                 donate_argnums=(1, 2))
         self._segment_c = jax.jit(self._segment_impl,
@@ -136,33 +198,39 @@ class ContinuousBatcher:
 
     def reset(self):
         """Fresh session on the SAME compiled programs: zero the caches,
-        masks and counters and rewind the position. Lets a caller (the
-        serve bench; a production recycle loop) run many sessions while
+        masks and counters and rewind every row. Lets a caller (the
+        serve bench; a long-running server) run many sessions while
         paying trace+compile once — the jitted pieces are per-instance
-        closures, so a new ContinuousBatcher would recompile."""
+        closures, so a new ContinuousBatcher would recompile. (With
+        per-row positions rows recycle in place, so this is hygiene
+        between WORKLOADS, not a horizon requirement.)"""
         self._caches = jax.tree.map(jnp.zeros_like, self._caches)
         self._slot_mask = jnp.zeros_like(self._slot_mask)
         self._cur_tok = jnp.zeros_like(self._cur_tok)
         self._n_logical = jnp.zeros_like(self._n_logical)
-        self.pos = self.Tb - 1
+        self._row_pos = [self.Tb - 1] * self.B
+        self.ticks = 0
 
     # ---- compiled pieces -------------------------------------------------
 
-    def _admit_impl(self, params, caches, slot_mask, row, prompt, pmask,
-                    off):
+    def _admit_impl(self, params, caches, slot_mask, row, prompt, pmask):
         """Prefill ONE request's tokens-but-the-last into cache row
-        ``row`` at slot offset ``off`` (= pos - prompt_buf + 1, so the
-        last prefilled token sits at the pool's current position).
+        ``row`` at the row's own window ``[0, prompt_buf)`` (left-padded:
+        an n-token head occupies slots ``prompt_buf - n ..
+        prompt_buf - 1``, so the last prefilled token always sits at
+        slot ``prompt_buf - 1``).
 
         The request's LAST prompt token is deliberately NOT prefilled:
         the host sets it as the row's current token and the next
-        segment's first tick consumes it — writing its K/V at the next
-        global slot and sampling the request's first new token exactly
-        as a standalone ``generate`` would. This keeps admission a pure
-        dispatch (no device->host read — a fetch costs ~130 ms on the
-        relayed-TPU transport, which at serving admission rates would
-        dominate everything; the only fetch in the serve loop is the
-        per-segment token harvest).
+        segment's first tick consumes it — writing its K/V at slot
+        ``prompt_buf`` and sampling the request's first new token
+        exactly as a standalone ``generate`` would. This keeps admission
+        a pure dispatch (no device->host read — a fetch costs ~130 ms on
+        the relayed-TPU transport, which at serving admission rates
+        would dominate everything; the only fetch in the serve loop is
+        the per-segment token harvest). The window offset is STATIC
+        (always 0): per-row positions removed the old
+        global-position-dependent offset entirely.
         """
         model, Tb = self.model, self.Tb
         pad_count = Tb - jnp.sum(pmask.astype(jnp.int32), axis=1)
@@ -176,7 +244,7 @@ class ContinuousBatcher:
             sink: list = []
             kw = {"kv_sink": sink, "kv_mask": pmask}
             if self._block_takes_positions:
-                kw["positions"] = off + jnp.arange(Tb)   # absolute slots
+                kw["positions"] = jnp.arange(Tb)   # absolute slots 0..Tb-1
             x = self._block.apply(p_i, x, **kw)
             if isinstance(x, tuple):   # MoE blocks return (x, aux)
                 x = x[0]
@@ -186,28 +254,28 @@ class ContinuousBatcher:
             {"kv": lax.dynamic_update_slice(
                 c["kv"],
                 jnp.stack([k, v]).astype(c["kv"].dtype),  # [2,1,hk,Tb,hd]
-                (0, row, 0, off, 0))}
+                (0, row, 0, 0, 0))}
             for c, (k, v) in zip(caches, kvs)]
-        # row's slot validity: dead before the window, the prompt mask
-        # inside it, open for decode after it — overwriting whatever the
-        # row's previous occupant left
-        m = jnp.ones((self.t_max,), jnp.float32)
-        m = lax.dynamic_update_slice(m, pmask[0].astype(jnp.float32),
-                                     (off,))
-        m = jnp.where(jnp.arange(self.t_max) < off, 0.0, m)
+        # row's slot validity: the prompt mask inside the window, open
+        # for decode after it — overwriting whatever the row's previous
+        # occupant left (slots beyond the live position are additionally
+        # hidden by the per-row position mask)
+        m = jnp.concatenate([pmask[0].astype(jnp.float32),
+                             jnp.ones((self.t_max - Tb,), jnp.float32)])
         slot_mask = lax.dynamic_update_slice(slot_mask, m[None], (row, 0))
         return caches, slot_mask
 
     def _segment_impl(self, params, caches, slot_mask, tok, n_logical,
-                      pos0):
-        """``S`` lockstep decode ticks for every row; returns the
+                      positions0):
+        """``S`` decode ticks for every row at its OWN position
+        (``positions0 [B]`` = each row's last written slot); returns the
         [B, S] greedy tokens and the carried state."""
         model = self.model
         blocks = params["blocks"]
 
         def tick(carry, i):
             tok, caches, n_log = carry
-            p = pos0 + 1 + i               # global slot being written
+            p = positions0 + 1 + i         # [B] per-row slot being written
             x = model.embed(params, tok[:, None], n_log[:, None])
             new_caches = []
             for li in range(self._n_layers):
@@ -225,9 +293,22 @@ class ContinuousBatcher:
 
     # ---- host scheduler --------------------------------------------------
 
+    def _rounded_need(self, max_new: int) -> int:
+        """Decode slots a request consumes past ``prompt_buf`` before its
+        row is harvested and freed: the SEGMENT-ROUNDED budget (a row
+        runs whole segments; eos can only shorten the output, not the
+        worst-case tick count)."""
+        return -(-max_new // self.S) * self.S
+
     def serve(self, requests: list[Request]) -> list[list[int]]:
         """Run every request through the pool; returns each request's
-        generated tokens (trimmed at eos), in request order."""
+        generated tokens (trimmed at eos), in request order.
+
+        Requests whose segment-rounded budget can never fit a row
+        (``prompt_buf + ceil(max_new/segment)*segment > t_max``) are
+        rejected: everything else is served to completion FIRST, then
+        :class:`HorizonError` is raised with ``.outputs`` carrying the
+        completed results."""
         for r in requests:
             if len(r.tokens) > self.Tb:
                 raise ValueError(
@@ -238,21 +319,19 @@ class ContinuousBatcher:
             if r.max_new < 1:
                 raise ValueError(f"max_new must be >= 1, got {r.max_new}")
         outputs: list[list[int] | None] = [None] * len(requests)
-        queue = list(range(len(requests)))
+        # per-request horizon gate (segment-rounded): a reject here is
+        # PERMANENT — per-row positions admit at the same window offset
+        # every time, so what can't fit now can never fit
+        rejected = [i for i, r in enumerate(requests)
+                    if self.Tb + self._rounded_need(r.max_new) > self.t_max]
+        rejected_set = set(rejected)
+        queue = [i for i in range(len(requests)) if i not in rejected_set]
         table = [_Slot() for _ in range(self.B)]
 
         def admit_next():
-            admitted = False
             for b, slot in enumerate(table):
                 if slot.req_index >= 0 or not queue:
                     continue
-                # optimistic capacity gate: the request needs AT LEAST
-                # max_new decode slots past the current position; the
-                # true need depends on scheduling, which the
-                # segment-overrun guard below bounds
-                nxt = requests[queue[0]]
-                if self.pos + nxt.max_new > self.t_max - 1:
-                    continue   # horizon exhausted for this one
                 ri = queue.pop(0)
                 req = requests[ri]
                 # prefill all but the last prompt token; the next
@@ -265,18 +344,16 @@ class ContinuousBatcher:
                 if n:
                     prompt[0, self.Tb - n:] = head
                     pmask[0, self.Tb - n:] = 1.0
-                off = self.pos - self.Tb + 1
                 self._caches, self._slot_mask = self._admit_c(
                     self.params, self._caches, self._slot_mask,
-                    jnp.int32(b), jnp.asarray(prompt), jnp.asarray(pmask),
-                    jnp.int32(off))
+                    jnp.int32(b), jnp.asarray(prompt), jnp.asarray(pmask))
                 self._cur_tok = self._cur_tok.at[b].set(last)
                 self._n_logical = self._n_logical.at[b].set(n)
+                self._row_pos[b] = self.Tb - 1   # the row's own horizon
                 slot.req_index = ri
                 slot.out = []
                 slot.remaining = req.max_new
-                admitted = True
-            return admitted
+            return
 
         def any_active():
             return any(s.req_index >= 0 for s in table)
@@ -284,21 +361,22 @@ class ContinuousBatcher:
         while queue or any_active():
             admit_next()
             if not any_active():
-                if queue:
-                    raise RuntimeError(
-                        f"horizon exhausted at pos={self.pos} with "
-                        f"{len(queue)} requests pending — raise t_max")
                 break
-            if self.pos + self.S > self.t_max - 1:
-                raise RuntimeError(
-                    f"horizon exhausted at pos={self.pos} (segment of "
-                    f"{self.S} would overrun t_max={self.t_max}) with "
-                    f"work in flight — raise t_max")
+            # park free rows at the window edge: they still tick (the
+            # compiled segment is all-rows), and rewinding keeps their
+            # garbage writes inside [Tb, Tb + S) — in range because any
+            # active admission implies Tb + S <= t_max
+            for b, slot in enumerate(table):
+                if slot.req_index < 0:
+                    self._row_pos[b] = self.Tb - 1
             (self._caches, self._cur_tok, self._n_logical, toks
              ) = self._segment_c(self.params, self._caches,
                                  self._slot_mask, self._cur_tok,
-                                 self._n_logical, jnp.int32(self.pos))
-            self.pos += self.S
+                                 self._n_logical,
+                                 jnp.asarray(self._row_pos, jnp.int32))
+            for b in range(self.B):
+                self._row_pos[b] += self.S
+            self.ticks += self.S
             toks_h = np.asarray(toks)
             for b, slot in enumerate(table):
                 if slot.req_index < 0:
@@ -307,7 +385,17 @@ class ContinuousBatcher:
                 slot.out.extend(int(t) for t in toks_h[b, :take])
                 slot.remaining -= take
                 self._finish_if_done(slot, outputs)
-        return [o if o is not None else [] for o in outputs]
+        results = [o if o is not None else [] for o in outputs]
+        if rejected:
+            worst = max(self._rounded_need(requests[i].max_new)
+                        for i in rejected)
+            raise HorizonError(
+                f"per-row horizon exhausted for {len(rejected)} "
+                f"request(s): prompt_buf={self.Tb} + segment-rounded "
+                f"max_new (worst {worst}) exceeds t_max={self.t_max} — "
+                f"raise t_max or shrink max_new (completed outputs are "
+                f"on this error's .outputs)", results)
+        return results
 
     def _finish_if_done(self, slot: _Slot, outputs):
         if slot.req_index < 0:

@@ -11,7 +11,8 @@ import pytest
 from jax import lax
 
 from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
-    cache_insert, cache_insert_pallas, kv_insert_all, kv_insert_pallas)
+    cache_insert, cache_insert_pallas, kv_insert_all, kv_insert_pallas,
+    kv_insert_rows_pallas)
 
 
 @pytest.mark.parametrize("pos", [0, 1, 7, 8, 32, 63, 96, 127])
@@ -64,6 +65,67 @@ def test_kv_pair_insert_matches_dus(pos, form):
     for n in cache:
         np.testing.assert_array_equal(np.asarray(ref[n]),
                                       np.asarray(got[n]), err_msg=n)
+
+
+@pytest.mark.parametrize("form", ["bf16", "int8kv"])
+def test_kv_rowwise_insert_matches_per_row_dus(form):
+    """The per-row window write (serve.py's per-row decode positions):
+    every batch row takes its update at ITS OWN slot — window-edge,
+    interior, first and last slots all in one call — and must equal a
+    per-row DUS, for both cache forms (incl. the int8 form's mixed
+    32-slot/8-slot windows)."""
+    B, HK, T, HD = 4, 3, 128, 64
+    key = jax.random.key(0)
+    if form == "bf16":
+        shapes = {"kv": (HD, jnp.bfloat16)}
+    else:
+        shapes = {"kv": (HD, jnp.int8), "scale": (1, jnp.float32)}
+    cache, upd = {}, {}
+    for i, (name, (hd, dt)) in enumerate(shapes.items()):
+        cache[name] = (jax.random.normal(
+            jax.random.fold_in(key, i), (2, B, HK, T, hd)) * 40
+        ).astype(dt)
+        upd[name] = (jax.random.normal(
+            jax.random.fold_in(key, 100 + i), (2, B, HK, 1, hd)) * 40
+        ).astype(dt)
+    pos = jnp.array([0, 7, 33, 127], jnp.int32)
+    ref = {n: np.asarray(cache[n]).copy() for n in cache}
+    for n in cache:
+        for b in range(B):
+            ref[n][:, b, :, int(pos[b])] = np.asarray(upd[n])[:, b, :, 0]
+    got = jax.jit(lambda c, u, p: kv_insert_rows_pallas(
+        c, u, p, interpret=True))(cache, upd, pos)
+    for n in cache:
+        np.testing.assert_array_equal(ref[n], np.asarray(got[n]),
+                                      err_msg=n)
+    # the dispatcher's vector-pos fallback (CPU / sharded) must agree
+    got2 = jax.jit(kv_insert_all)(cache, upd, pos)
+    for n in cache:
+        np.testing.assert_array_equal(ref[n], np.asarray(got2[n]),
+                                      err_msg=n)
+
+
+def test_kv_rowwise_insert_in_scan_traced_positions():
+    """The serving decode pattern: traced PER-ROW positions advancing
+    inside lax.scan (every row at its own offset)."""
+    B, HK, T, HD = 3, 1, 16, 8
+    cache0 = {"kv": jnp.zeros((2, B, HK, T, HD), jnp.float32)}
+    base = jnp.array([0, 5, 11], jnp.int32)
+
+    @jax.jit
+    def run(cache):
+        def tick(c, i):
+            upd = {"kv": jnp.full((2, B, HK, 1, HD), i + 1, jnp.float32)}
+            return kv_insert_all(c, upd, base + i), None
+        out, _ = lax.scan(tick, cache, jnp.arange(4))
+        return out
+    out = np.asarray(run(cache0)["kv"])
+    for b, o in enumerate([0, 5, 11]):
+        for i in range(4):
+            assert (out[:, b, 0, o + i] == i + 1).all(), (b, i)
+        mask = np.ones(T, bool)
+        mask[o:o + 4] = False
+        assert (out[:, b, 0, mask] == 0).all(), b
 
 
 def test_kv_pair_insert_falls_back_off_tpu():

@@ -1,8 +1,10 @@
 """Continuous batching (serve.py): staggered admissions through the
 fixed slot pool must reproduce each prompt's STANDALONE generation
-exactly — the left-aligned admission, per-row slot masks, and per-family
-position handling (logical embed / absolute-slot rope) all have to line
-up for this to hold token-for-token."""
+exactly — the fixed-window admission, per-row positions and slot masks,
+and per-family position handling (logical embed / absolute-per-row-slot
+rope) all have to line up for this to hold token-for-token — and the
+per-row horizon must let streams outlive what the old lockstep design
+could serve."""
 
 import dataclasses
 
@@ -17,7 +19,8 @@ from distributed_compute_pytorch_tpu.models.llama import (
     LlamaConfig, LlamaLM)
 from distributed_compute_pytorch_tpu.models.moe import (
     MoETransformerConfig, MoETransformerLM)
-from distributed_compute_pytorch_tpu.serve import ContinuousBatcher, Request
+from distributed_compute_pytorch_tpu.serve import (
+    ContinuousBatcher, HorizonError, Request)
 
 
 def _models():
@@ -47,9 +50,9 @@ def _requests(rng, n, vocab=256, min_len=2, max_len=10, min_new=3,
 @pytest.mark.parametrize("name,model", _models())
 def test_staggered_admissions_match_standalone(name, model):
     """The gold serving test: 7 mixed-length requests through 2 slots
-    with a small segment — every admission lands at a different global
-    position, and each request's served tokens must equal its standalone
-    greedy generate()."""
+    with a small segment — admissions land staggered across segments
+    (each rewinding its row's own position), and each request's served
+    tokens must equal its standalone greedy generate()."""
     params, _ = model.init(jax.random.key(0))
     rng = np.random.default_rng(3)
     reqs = _requests(rng, 7)
@@ -116,13 +119,127 @@ def test_validation_and_horizon():
         cb.serve([Request(tokens=[], max_new=2)])
     with pytest.raises(ValueError, match="prompt_buf"):
         ContinuousBatcher(model, params, slots=1, t_max=8, prompt_buf=16)
-    # horizon: t_max=32, prompt_buf=8 -> ~24 decode slots; five 16-token
-    # requests cannot fit and must raise the clear horizon error
+    # the per-row horizon is PER REQUEST: a budget whose segment-rounded
+    # need (ceil(max_new/S)*S) can never fit t_max - prompt_buf is
+    # rejected with the horizon error — but only AFTER everything
+    # admissible completed, and the error carries those outputs
     cb2 = ContinuousBatcher(model, params, slots=1, t_max=32, prompt_buf=8,
                             segment=4)
-    with pytest.raises(RuntimeError, match="horizon"):
-        cb2.serve([Request(tokens=[1, 2, 3], max_new=16)
-                   for _ in range(5)])
+    fits = Request(tokens=[1, 2, 3], max_new=4)
+    solo = generate(model, params, jnp.asarray([fits.tokens], jnp.int32), 4)
+    want = [int(t) for t in np.asarray(solo)[0, len(fits.tokens):]]
+    with pytest.raises(HorizonError, match="horizon") as ei:
+        cb2.serve([Request(tokens=[1, 2, 3], max_new=32),   # need 32 > 24
+                   Request(list(fits.tokens), fits.max_new)])
+    assert ei.value.outputs == [[], want]
+
+
+def test_long_stream_outlives_lockstep_horizon():
+    """The tentpole regression: five 16-token requests through one slot
+    at t_max=32 need 80 total decode ticks — far past the old design's
+    shared t_max horizon (which raised RuntimeError here). Per-row
+    positions recycle the row in place, so the stream completes in one
+    session AND stays token-identical to standalone generation."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    cb = ContinuousBatcher(model, params, slots=1, t_max=32, prompt_buf=8,
+                           segment=4)
+    reqs = [Request(tokens=[1 + i, 2, 3], max_new=16) for i in range(5)]
+    outs = cb.serve([Request(list(r.tokens), r.max_new) for r in reqs])
+    assert cb.ticks >= 5 * 16 > cb.t_max   # ticks exceeded the old horizon
+    for i, (req, out) in enumerate(zip(reqs, outs)):
+        solo = generate(model, params,
+                        jnp.asarray([req.tokens], jnp.int32), req.max_new)
+        want = [int(t) for t in np.asarray(solo)[0, len(req.tokens):]]
+        assert out == want, (i, out, want)
+
+
+@pytest.mark.parametrize("name,model", _models())
+def test_long_stream_all_families(name, model):
+    """Mixed-length stream needing more total ticks than t_max, through
+    2 slots — row recycling must stay exact for every family (learned
+    positions, per-row-slot RoPE, MoE routing)."""
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(17)
+    reqs = _requests(rng, 9, min_new=5, max_new=10)
+    cb = ContinuousBatcher(model, params, slots=2, t_max=32,
+                           prompt_buf=10, segment=3)
+    outs = cb.serve([Request(list(r.tokens), r.max_new) for r in reqs])
+    assert cb.ticks * 1 > cb.t_max - cb.Tb   # outlived a lockstep session
+    for i, (req, out) in enumerate(zip(reqs, outs)):
+        solo = generate(model, params,
+                        jnp.asarray([req.tokens], jnp.int32), req.max_new)
+        want = [int(t) for t in np.asarray(solo)[0, len(req.tokens):]]
+        assert out == want, (name, i, out, want)
+
+
+def test_odd_t_max_rounds_to_window_and_matches():
+    """ADVICE r5: an odd t_max (the longest-prompt parity leak from
+    cli_serve's default sizing) must be rounded up to the Pallas
+    cache-window multiple — never silently serve off the fast path —
+    and parity must hold at the rounded shape."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    cb = ContinuousBatcher(model, params, slots=2, t_max=37, prompt_buf=9,
+                           segment=3)
+    assert cb.t_max == 40 and cb.t_max % 8 == 0
+    assert all(c["kv"].shape[3] == 40 for c in cb._caches)
+    rng = np.random.default_rng(23)
+    reqs = _requests(rng, 5, max_len=9)
+    outs = cb.serve([Request(list(r.tokens), r.max_new) for r in reqs])
+    for i, (req, out) in enumerate(zip(reqs, outs)):
+        solo = generate(model, params,
+                        jnp.asarray([req.tokens], jnp.int32), req.max_new)
+        want = [int(t) for t in np.asarray(solo)[0, len(req.tokens):]]
+        assert out == want, (i, out, want)
+
+
+def test_eos_early_exit_reuses_slot_under_per_row_positions():
+    """A row that hits eos frees mid-stream and its slot is immediately
+    re-admitted AT THE SAME WINDOW (per-row positions rewind the row);
+    the tight t_max forces several recycles of both slots, and every
+    request must still match its standalone run."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(29)
+    reqs = _requests(rng, 6, min_new=6, max_new=6)
+    solo0 = generate(model, params,
+                     jnp.asarray([reqs[0].tokens], jnp.int32), 6)
+    eos = int(np.asarray(solo0)[0, len(reqs[0].tokens) + 1])
+    # t_max 24: need = ceil(6/3)*3 = 6 <= 24 - 10; six requests need ~36
+    # total ticks > t_max, so slots must recycle to finish
+    cb = ContinuousBatcher(model, params, slots=2, t_max=24,
+                           prompt_buf=10, segment=3, eos_id=eos)
+    outs = cb.serve([Request(list(r.tokens), r.max_new) for r in reqs])
+    for i, (req, out) in enumerate(zip(reqs, outs)):
+        solo = generate(model, params,
+                        jnp.asarray([req.tokens], jnp.int32), req.max_new)
+        want = [int(t) for t in np.asarray(solo)[0, len(req.tokens):]]
+        if eos in want:
+            want = want[:want.index(eos) + 1]
+        assert out == want, (i, out, want)
+        assert len(out) <= req.max_new
+
+
+def test_int8_weight_quantized_parity():
+    """The int8 serving path (--quantize int8): served greedy outputs
+    equal standalone generate over the SAME quantized params, and the
+    bf16 cache dtype still rounds t_max to the 8-slot window."""
+    from distributed_compute_pytorch_tpu.utils.quantize import (
+        quantize_params_int8)
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    qp = jax.jit(quantize_params_int8)(params)
+    rng = np.random.default_rng(31)
+    reqs = _requests(rng, 5)
+    cb = ContinuousBatcher(model, qp, slots=2, t_max=64, prompt_buf=10,
+                           segment=3)
+    outs = cb.serve([Request(list(r.tokens), r.max_new) for r in reqs])
+    for i, (req, out) in enumerate(zip(reqs, outs)):
+        solo = generate(model, qp,
+                        jnp.asarray([req.tokens], jnp.int32), req.max_new)
+        want = [int(t) for t in np.asarray(solo)[0, len(req.tokens):]]
+        assert out == want, (i, out, want)
 
 
 def test_reset_reuses_compiled_programs():
