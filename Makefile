@@ -6,7 +6,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 test bench
+.PHONY: tier1 test bench bench-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -17,3 +17,10 @@ test:
 
 bench:
 	python bench.py
+
+# CPU-sized end-to-end run of the ZeRO-1 update-sharding bench stage
+# (tiny model, faked 4-device CPU mesh): exercises the bench plumbing —
+# sharded init, both step programs, the opt-HBM byte meter — in tier-1
+# time budgets, and fails if sharding doesn't shrink per-chip opt state
+bench-smoke:
+	JAX_PLATFORMS=cpu python bench.py --zero1-smoke

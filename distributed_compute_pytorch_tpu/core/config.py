@@ -127,6 +127,16 @@ class Config:
     clip_norm: float = 0.0         # global-grad-norm clip (0 = off)
     grad_accum: int = 1            # micro-steps accumulated per update
     warmup_steps: int = 0          # LR warmup updates (adamw schedule)
+    # ZeRO-1 cross-replica weight-update sharding (train/step.py,
+    # parallel/collectives.py): reduce-scatter grads -> shard-local
+    # optimizer update (opt_state born sharded, 1/N per chip) ->
+    # all-gather params. 'auto' (default) = on when the strategy is pure
+    # DataParallel and the dp world size > 1; 'on'/'off' force it.
+    shard_update: str = "auto"
+    # opt-in block-scaled int8 gradient collectives for the sharded
+    # update (EQuARX-style): int8 + per-block f32 scales on the wire,
+    # f32 accumulate; bounded quantization error on the gradients
+    quant_collectives: bool = False
     # Megatron sequence-parallel activations on tensor>1 meshes: residual
     # stream's token dim sharded over `tensor` between blocks (transformer
     # models; numerics-transparent)
@@ -263,6 +273,19 @@ class Config:
         p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps,
                        help="LR warmup updates for the adamw "
                             "warmup-cosine schedule")
+        p.add_argument("--shard_update", type=str, default=cls.shard_update,
+                       choices=("auto", "on", "off"),
+                       help="ZeRO-1 weight-update sharding over the dp "
+                            "axes: reduce-scatter grads, shard-local "
+                            "optimizer update (opt_state 1/N per chip), "
+                            "all-gather params. auto = on for pure "
+                            "DataParallel with dp world size > 1")
+        p.add_argument("--quant_collectives", action="store_true",
+                       help="opt-in block-scaled int8 gradient "
+                            "collectives for the sharded update (int8 + "
+                            "f32 scales on the wire, f32 accumulate; "
+                            "bounded gradient quantization error; "
+                            "stateless models, single dp axis)")
         p.add_argument("--seq_shard_activations", action="store_true",
                        help="Megatron sequence-parallel activations: shard "
                             "the residual stream's token dim over `tensor` "

@@ -243,6 +243,30 @@ def pcast_varying(x, axes):
     return pc(x, axes, to="varying")
 
 
+_manual_stack: list[frozenset] = []
+
+
+class use_manual_axes:
+    """Trace-time declaration that ``axes`` are MANUAL in the enclosing
+    shard_map region, for jax versions whose sharding API cannot report
+    it (no ``get_abstract_mesh``). ``constrain``/``constrain_replicated``
+    consult this and drop the declared axes from their specs — the
+    correct semantics inside the region, where those dims are local.
+    Used by the ZeRO-1 quantized train path (``train/step.py``), whose
+    shard_map body runs the whole model forward manual over the dp axes.
+    """
+
+    def __init__(self, axes):
+        self.axes = frozenset(axes)
+
+    def __enter__(self):
+        _manual_stack.append(self.axes)
+        return self
+
+    def __exit__(self, *exc):
+        _manual_stack.pop()
+
+
 def _manual_axis_names() -> tuple[set, object]:
     """``(manual_axis_names, abstract_mesh_or_None)`` from the current
     trace context. ``jax.sharding.get_abstract_mesh``/``AxisType`` are
@@ -252,17 +276,19 @@ def _manual_axis_names() -> tuple[set, object]:
     so falling back to "no manual axes known" preserves behaviour
     everywhere the explicit path reaches — instead of the hard
     AttributeError the missing symbol used to raise on every
-    mesh-active forward."""
+    mesh-active forward. Axes declared via :class:`use_manual_axes`
+    are always included (both jax generations)."""
+    extra: set = set().union(*_manual_stack) if _manual_stack else set()
     get_am = getattr(jax.sharding, "get_abstract_mesh", None)
     axis_type = getattr(jax.sharding, "AxisType", None)
     if get_am is None or axis_type is None:
-        return set(), None
+        return extra, None
     am = get_am()
     if am is None or am.empty:
-        return set(), None
+        return extra, None
     manual = {n for n, t in zip(am.axis_names, am.axis_types)
               if t == axis_type.Manual}
-    return manual, am
+    return manual | extra, am
 
 
 def constrain(x, spec: P):
@@ -291,7 +317,9 @@ def constrain(x, spec: P):
     cleaned = tuple(clean(a) for a in spec)
     if all(a is None for a in cleaned):
         return x
-    target = mesh if not manual else am
+    # legacy shard_map (no abstract mesh): a constraint naming only
+    # still-automatic axes may bind against the concrete mesh
+    target = am if (manual and am is not None) else mesh
     return jax.lax.with_sharding_constraint(
         x, jax.sharding.NamedSharding(target, P(*cleaned)))
 

@@ -100,6 +100,13 @@ class MoELayer:
     # mid-generation. Never for training/prefill shapes (C ~ N is the
     # quadratic dispatch wall).
     full_capacity: bool = False
+    # explicit per-group capacity, overriding the capacity_factor formula.
+    # The serving admission prefill uses this to route its fixed padded
+    # window at the capacity the REAL (unpadded) token count implies —
+    # pad tokens claim no queue slot (token_mask), so with the override
+    # the real tokens see exactly the standalone prefill's queues
+    # (serve.ContinuousBatcher, ADVICE r5's capacity divergence).
+    capacity_override: int | None = None
     param_dtype: jnp.dtype = jnp.float32
 
     def init(self, key):
@@ -118,6 +125,8 @@ class MoELayer:
     def capacity(self, group_tokens: int) -> int:
         if self.full_capacity:
             return group_tokens
+        if self.capacity_override is not None:
+            return max(int(self.capacity_override), 1)
         c = int(self.capacity_factor * self.top_k * group_tokens
                 / self.num_experts)
         return max(c, 1)
@@ -408,13 +417,19 @@ class MoEBlock:
                         dispatch_mode=c.dispatch_mode,
                         param_dtype=c.param_dtype)
 
-    def _moe_infer(self, n_tokens: int, decode: bool) -> MoELayer:
+    def _moe_infer(self, n_tokens: int, decode: bool,
+                   capacity_override: int | None = None) -> MoELayer:
         """Inference-routing layer (argmax selection; class docstring):
         full-capacity single group for decode ticks, grouped +
-        eval-capacity for prefill."""
+        eval-capacity for prefill. ``capacity_override`` (the serving
+        admission path) pins the queue capacity explicitly — and forces
+        a single global group, because the override expresses "route
+        these ``n_real`` tokens as a standalone global-group prefill
+        would" and per-group boundaries over a padded window cannot line
+        up with the unpadded run's."""
         c = self.config
         group = None
-        if (not decode and c.moe_group_size
+        if (capacity_override is None and not decode and c.moe_group_size
                 and n_tokens % c.moe_group_size == 0):
             group = c.moe_group_size
         ecf = (c.eval_capacity_factor
@@ -424,7 +439,16 @@ class MoEBlock:
             c.d_model, c.d_ff, c.num_experts, ecf,
             top_k=c.top_k, group_size=group, router_balance="aux",
             dispatch_mode=c.dispatch_mode, full_capacity=decode,
+            capacity_override=capacity_override,
             param_dtype=c.param_dtype)
+
+    def prefill_capacity(self, n_tokens: int) -> int:
+        """Expert queue capacity a STANDALONE global-group prefill of
+        ``n_tokens`` real tokens would use — what the serving admission
+        passes back as ``moe_capacity`` so its fixed padded window routes
+        at the real prompt's capacity (``serve.ContinuousBatcher``)."""
+        return self._moe_infer(max(n_tokens, 1),
+                               decode=False).capacity(max(n_tokens, 1))
 
     def init(self, key):
         c = self.config
@@ -440,7 +464,7 @@ class MoEBlock:
         }
 
     def apply(self, p, x, *, rng=None, train: bool = False, kv_mask=None,
-              manual_axes=(), kv_sink=None):
+              manual_axes=(), kv_sink=None, moe_capacity=None):
         from distributed_compute_pytorch_tpu.models.transformer import (
             attention_sublayer)
         c = self.config
@@ -459,8 +483,12 @@ class MoEBlock:
             # selection, eval capacity; see class docstring). The prompt
             # mask keeps left-pad tokens out of the routing queues so
             # they can never evict a real token when capacity binds.
+            # ``moe_capacity`` (static int; the serving admission) pins
+            # the queue capacity to the REAL token count's instead of
+            # deriving it from the padded window size.
             B, T, _ = h.shape
-            moe = self._moe_infer(B * T, decode=False)
+            moe = self._moe_infer(B * T, decode=False,
+                                  capacity_override=moe_capacity)
             y, aux = moe.apply(p["moe"], h, token_mask=kv_mask)
         else:
             y, aux = self._moe().apply(p["moe"], h)

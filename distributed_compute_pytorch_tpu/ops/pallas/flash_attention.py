@@ -44,6 +44,14 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK = 128
 _NEG_INF = -1e30
 
+# jax-version probe (same shim pattern as core/mesh.py): newer jax spells
+# it pltpu.CompilerParams; the container's 0.4.x only has
+# TPUCompilerParams (same dimension_semantics kwarg). Without this the
+# module — and everything importing it (fused_adamw, the flash suites) —
+# fails at IMPORT on older jax.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 
 def _dot_tt(a, b):
     """``a @ b.T`` via dot_general contracting the trailing dims — the MXU
@@ -179,7 +187,7 @@ def _flash_fwd(q, k, v, kv_mask, heads, scale, causal, offset,
         # batch*heads and q blocks are independent — declaring them parallel
         # lets Mosaic pipeline (double-buffer) block loads across grid steps;
         # only the kv axis carries the accumulator dependency
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
     )(*args)
@@ -312,7 +320,7 @@ def _flash_bwd(res, g, kv_mask, heads, scale, causal, offset,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
     )(q, k, v, g.astype(q.dtype), lse, delta, *extra)
@@ -346,7 +354,7 @@ def _flash_bwd(res, g, kv_mask, heads, scale, causal, offset,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
     )(q, k, v, g.astype(q.dtype), lse, delta, *extra)

@@ -43,6 +43,12 @@ from jax.experimental import pallas as pl
 from distributed_compute_pytorch_tpu.ops.pallas.flash_attention import (
     _use_interpret)
 
+# optax renamed safe_int32_increment -> safe_increment; the container's
+# older optax only has the former (same core/mesh.py version-probe shim
+# pattern)
+_safe_increment = getattr(optax, "safe_increment", None) or \
+    optax.safe_int32_increment
+
 
 class FusedAdamWState(NamedTuple):
     count: jax.Array          # int32 step counter (for bias correction + lr)
@@ -157,7 +163,7 @@ def fused_adamw(learning_rate: float | Callable[[jax.Array], jax.Array],
         new_mu = jax.tree.unflatten(treedef, [o[1] for o in outs])
         new_nu = jax.tree.unflatten(treedef, [o[2] for o in outs])
         return new_params, FusedAdamWState(
-            count=optax.safe_increment(state.count),
+            count=_safe_increment(state.count),
             mu=new_mu, nu=new_nu)
 
     def update(grads, state, params=None):

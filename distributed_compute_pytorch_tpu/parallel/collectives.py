@@ -1,0 +1,227 @@
+"""Data-parallel weight-update sharding (ZeRO-1) collectives.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (PAPERS.md) observes that under plain data parallelism every
+replica all-reduces full gradients and then runs the SAME O(params)
+optimizer update on the SAME replicated optimizer state — N-1 redundant
+update passes and N-1 redundant copies of ``opt_state`` (2x params for
+AdamW). The fix is a pure dataflow transform:
+
+    all-reduce(grads) -> update          becomes
+    reduce-scatter(grads) -> shard-local update -> all-gather(params)
+
+Comm volume is unchanged (an all-reduce IS a reduce-scatter + all-gather),
+the update compute and optimizer memory drop by the dp-axis size, and the
+params the next forward sees are bit-identical up to reduction order.
+
+Two integration styles live here:
+
+- **Annotation-driven (the paper's, used by the exact path in
+  ``train/step.py``)**: the update stage runs inside a ``shard_map``
+  manual over the dp axis whose in/out specs mark each leaf's shard
+  layout; XLA's SPMD partitioner materialises the pending gradient psum
+  AS a reduce-scatter at the region boundary and the closing
+  ``with_sharding_constraint`` to replicated AS the param all-gather.
+  ``update_shard_spec``/``tree_update_specs`` choose the per-leaf layout.
+- **Explicit manual-region collectives** (:func:`reduce_scatter`,
+  :func:`all_gather`, :func:`quantized_reduce_scatter`): for code already
+  inside a shard_map body that holds per-rank values — the quantized
+  train path in ``train/step.py`` computes per-shard grads inside the
+  region and reduces them here, which is the only place a QUANTIZED
+  gradient collective can honestly exist at the JAX level (the automatic
+  partitioner's reductions are always exact f32; EQuARX does this inside
+  XLA itself).
+
+The quantized reduce-scatter (EQuARX-motivated) exchanges block-scaled
+int8 instead of f32: each rank splits its local gradient into N chunks
+along the shard dim, quantizes each chunk with one f32 scale per
+``block`` contiguous elements (symmetric abs-max/127), all-to-alls the
+int8 payload + scales, and dequant-accumulates in f32. Wire bytes drop
+~4x (int8 + scales/block vs f32); error is bounded by the sum over ranks
+of each block's quantization step (tests/test_collectives.py pins it on
+adversarial large-dynamic-range gradients). Chunks too small to amortise
+scales (< ``min_int8_elems``) fall back to a bf16 exchange instead —
+still half the f32 bytes, no scale bookkeeping.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_compute_pytorch_tpu.core.mesh import BATCH_AXES
+
+# leaves smaller than this stay replicated (biases, norm scales): the
+# all-gather latency would cost more than the duplicate update saves —
+# same threshold philosophy as parallel.api.FSDP.min_size_to_shard
+MIN_SIZE_TO_SHARD = 1024
+
+# int8 quantization granularity: one f32 scale per this many elements
+DEFAULT_BLOCK = 256
+
+# below this many elements per exchanged chunk the int8 scales stop
+# amortising; exchange bf16 instead (the ISSUE's "leaf too small" fallback)
+MIN_INT8_ELEMS = 2048
+
+
+def dp_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The batch axes a ``DataParallel`` gradient psum pends over (size>1
+    only) — the axes a ZeRO-1 update shards across."""
+    return tuple(a for a in BATCH_AXES
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
+def dp_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape[a] for a in dp_axes(mesh)) or 1
+
+
+def update_shard_spec(shape: tuple[int, ...], n: int,
+                      axes: tuple[str, ...],
+                      min_size: int = MIN_SIZE_TO_SHARD) -> P:
+    """PartitionSpec sharding one leaf 1/n for the weight update: the
+    largest dim divisible by ``n`` carries the (possibly multi-axis) dp
+    axes; indivisible or tiny leaves stay replicated (``P()``) and pay
+    the old replicated update — they are the byte-budget rounding error.
+    Deterministic in ``shape`` alone, so gradient, param, and optimizer
+    moment leaves of one parameter always agree on the layout."""
+    if n <= 1 or int(np.prod(shape)) < min_size:
+        return P()
+    best, best_dim = -1, None
+    for d, s in enumerate(shape):
+        if s % n == 0 and s > best:
+            best, best_dim = s, d
+    if best_dim is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best_dim] = axes if len(axes) > 1 else axes[0]
+    return P(*spec)
+
+
+def tree_update_specs(tree, n: int, axes: tuple[str, ...],
+                      min_size: int = MIN_SIZE_TO_SHARD):
+    """Per-leaf :func:`update_shard_spec` pytree (accepts abstract
+    ``eval_shape`` trees). Applied uniformly to params AND opt_state:
+    optimizer moments share their parameter's shape, so they land on the
+    identical layout; scalars (step counts) come out ``P()``."""
+    def spec(leaf):
+        s = getattr(leaf, "shape", None)
+        shape = tuple(s) if s is not None else np.shape(leaf)
+        return update_shard_spec(shape, n, axes, min_size)
+    return jax.tree.map(spec, tree)
+
+
+def tree_update_shardings(tree, mesh: Mesh,
+                          min_size: int = MIN_SIZE_TO_SHARD):
+    """NamedSharding pytree for a state tree born in the ZeRO-1 layout
+    (``train/step.py::init_fn`` out_shardings)."""
+    axes = dp_axes(mesh)
+    n = dp_size(mesh)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        tree_update_specs(tree, n, axes, min_size))
+
+
+# ---------------------------------------------------------------------------
+# explicit manual-region collectives (callers are inside a shard_map body
+# manual over `axis_name`; arrays are the per-rank LOCAL values)
+# ---------------------------------------------------------------------------
+
+
+def reduce_scatter(x, axis_name, dim: int = 0):
+    """Exact f32-accurate reduce-scatter of per-rank partials: every rank
+    holds a full-shaped local contribution; rank i returns the summed
+    ``1/N`` shard along ``dim``."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def all_gather(x, axis_name, dim: int = 0):
+    """Concatenate every rank's shard along ``dim`` (tiled): the param
+    re-replication leg of the RS -> update -> AG dance."""
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _q8_blocks(flat, block: int):
+    """Block-scaled symmetric int8: ``flat [M]`` (M % block == 0) ->
+    ``(q int8 [M/block, block], scale f32 [M/block, 1])``. The 1e-30
+    floor keeps all-zero blocks finite (q = 0 exactly)."""
+    xb = flat.reshape(-1, block)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(xb), axis=1, keepdims=True) / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantized_reduce_scatter(x, axis_name, n: int, dim: int = 0,
+                             block: int = DEFAULT_BLOCK,
+                             min_int8_elems: int = MIN_INT8_ELEMS):
+    """Block-scaled int8 reduce-scatter of per-rank partials over
+    ``axis_name`` (size ``n``).
+
+    Each rank splits its local full-shaped contribution into ``n`` chunks
+    along ``dim``, quantizes each chunk (one f32 scale per ``block``
+    flattened elements, chunk tail padded to a block multiple), exchanges
+    the int8 payload + scales with one ``all_to_all``, and accumulates
+    the ``n`` dequantized chunks in f32 — so the CROSS-REPLICA WIRE
+    carries ~1/4 the f32 bytes while the accumulation stays f32.
+
+    Error bound: per output element, at most ``sum_over_ranks(
+    block_absmax_r / 127 * 0.5)`` — each rank's contribution is off by
+    at most half its block's quantization step (pinned on adversarial
+    dynamic-range gradients in tests/test_collectives.py).
+
+    Fallback: chunks smaller than ``min_int8_elems`` exchange bf16
+    instead (scales would not amortise; still half the f32 wire bytes).
+    ``x.shape[dim]`` must divide by ``n`` — indivisible leaves should
+    stay replicated (``update_shard_spec`` returns ``P()`` for them and
+    the caller psums exactly).
+    """
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"quantized_reduce_scatter: dim {dim} of {x.shape} does not "
+            f"divide by the axis size {n}; keep this leaf replicated")
+    # chunk-major layout [n, ...chunk...] so all_to_all's split axis is 0
+    moved = jnp.moveaxis(x, dim, 0)
+    chunk_shape = (moved.shape[0] // n,) + moved.shape[1:]
+    chunks = moved.reshape((n,) + chunk_shape)
+    elems = int(np.prod(chunk_shape))
+    if elems < min_int8_elems:
+        sent = lax.all_to_all(chunks.astype(jnp.bfloat16), axis_name,
+                              split_axis=0, concat_axis=0)
+        red = jnp.sum(sent.astype(jnp.float32), axis=0)
+    else:
+        pad = (-elems) % block
+        flat = chunks.reshape(n, elems)
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+        q, s = jax.vmap(lambda c: _q8_blocks(c, block))(flat)
+        q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+        s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+        deq = q.astype(jnp.float32) * s            # [n, nblk, block]
+        red = jnp.sum(deq, axis=0).reshape(-1)
+        if pad:
+            red = red[:elems]
+        red = red.reshape(chunk_shape)
+    return jnp.moveaxis(red.astype(x.dtype), 0, dim)
+
+
+def shard_slice(x, axis_name, n: int, dim: int = 0):
+    """This rank's 1/n shard of a REPLICATED local value ``x`` (inside a
+    manual region): the zero-comm complement of :func:`all_gather`, used
+    where params enter a region replicated but the update runs on the
+    shard."""
+    size = x.shape[dim] // n
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
+
+
+def spec_shard_dim(spec: P):
+    """The dim a :func:`update_shard_spec` spec shards, or None (``P()``,
+    replicated leaf)."""
+    for d, entry in enumerate(spec):
+        if entry is not None:
+            return d
+    return None

@@ -59,14 +59,20 @@ outputs (``.outputs``) instead of discarding finished work.
 Correctness contract (``tests/test_serve.py``): greedy-served outputs of
 staggered admissions equal each prompt's standalone ``infer.generate``,
 token for token, for GPT-2 (learned positions), Llama (RoPE/GQA) and the
-MoE family (inference routing). MoE no-drop precondition: admission
-prefills one row over the fixed ``prompt_buf`` window, so its expert
-capacity ``C = ceil(ecf * top_k * N / E)`` is derived from
-``prompt_buf`` — NOT from the prompt's real token count the standalone
-path sees. The two paths therefore agree token-for-token only while
-eval capacity never binds (no token is capacity-dropped on either
-path); size ``eval_capacity_factor`` for the no-drop regime when
-serving MoE models.
+MoE family (inference routing). MoE capacity: although admission
+prefills one row over the fixed ``prompt_buf`` window, the expert queue
+capacity is derived from the REAL prompt length (``moe_capacity``,
+static per admission — ``MoEBlock.prefill_capacity``), and pad tokens
+claim no queue slot, so the prefilled prompt tokens route with exactly
+the queues a standalone global-group prefill gives them even when
+capacity binds (ADVICE r5's serve-vs-standalone capacity divergence,
+closed). The remaining documented no-drop contract is only the LAST
+prompt token: serve defers it to the first decode tick, which is
+full-capacity by construction, while the standalone prefill routes it
+with capacity ``C`` — the paths can disagree only if the standalone run
+capacity-drops that one token (and, for ``top_k=2``, via its slot-2
+queue priorities; ``tests/test_serve.py`` pins both the binding-capacity
+parity and this boundary).
 """
 
 from __future__ import annotations
@@ -156,6 +162,11 @@ class ContinuousBatcher:
         # at admission)? Llama does; GPT-2/MoE embed positions instead.
         self._block_takes_positions = "positions" in inspect.signature(
             self._block.apply).parameters
+        # MoE admission capacity (ADVICE r5): blocks whose prefill routing
+        # accepts an explicit capacity get it derived from the REAL prompt
+        # length, not the padded window (see _admit_impl)
+        self._block_takes_moe_capacity = "moe_capacity" in inspect.signature(
+            self._block.apply).parameters
         hk, hd = model.kv_cache_spec()
         n_layers = int(jax.tree_util.tree_leaves(
             params["blocks"])[0].shape[0])
@@ -191,8 +202,12 @@ class ContinuousBatcher:
         # rewinds a row to Tb-1, each segment advances every row by S)
         self._row_pos = [prompt_buf - 1] * slots
         self.ticks = 0             # decode ticks run this session
-        self._admit_c = jax.jit(self._admit_impl,
-                                donate_argnums=(1, 2))
+        # moe_capacity is STATIC: capacity shapes the routing one-hots, so
+        # each distinct capacity value compiles its own admission program
+        # (bounded by ceil(ecf * top_k * prompt_buf / E) values — the same
+        # per-shape compilation the standalone prefill always paid)
+        self._admit_c = jax.jit(self._admit_impl, donate_argnums=(1, 2),
+                                static_argnames=("moe_capacity",))
         self._segment_c = jax.jit(self._segment_impl,
                                   donate_argnums=(1,))
 
@@ -213,7 +228,8 @@ class ContinuousBatcher:
 
     # ---- compiled pieces -------------------------------------------------
 
-    def _admit_impl(self, params, caches, slot_mask, row, prompt, pmask):
+    def _admit_impl(self, params, caches, slot_mask, row, prompt, pmask,
+                    moe_capacity=None):
         """Prefill ONE request's tokens-but-the-last into cache row
         ``row`` at the row's own window ``[0, prompt_buf)`` (left-padded:
         an n-token head occupies slots ``prompt_buf - n ..
@@ -245,6 +261,11 @@ class ContinuousBatcher:
             kw = {"kv_sink": sink, "kv_mask": pmask}
             if self._block_takes_positions:
                 kw["positions"] = jnp.arange(Tb)   # absolute slots 0..Tb-1
+            if self._block_takes_moe_capacity and moe_capacity is not None:
+                # expert queues sized for the REAL token count: pads route
+                # nowhere (kv_mask), so the real tokens see exactly the
+                # standalone prefill's capacity instead of the window's
+                kw["moe_capacity"] = moe_capacity
             x = self._block.apply(p_i, x, **kw)
             if isinstance(x, tuple):   # MoE blocks return (x, aux)
                 x = x[0]
@@ -344,9 +365,12 @@ class ContinuousBatcher:
                 if n:
                     prompt[0, self.Tb - n:] = head
                     pmask[0, self.Tb - n:] = 1.0
+                cap = (self._block.prefill_capacity(len(req.tokens))
+                       if self._block_takes_moe_capacity else None)
                 self._caches, self._slot_mask = self._admit_c(
                     self.params, self._caches, self._slot_mask,
-                    jnp.int32(b), jnp.asarray(prompt), jnp.asarray(pmask))
+                    jnp.int32(b), jnp.asarray(prompt), jnp.asarray(pmask),
+                    moe_capacity=cap)
                 self._cur_tok = self._cur_tok.at[b].set(last)
                 self._n_logical = self._n_logical.at[b].set(n)
                 self._row_pos[b] = self.Tb - 1   # the row's own horizon

@@ -8,7 +8,10 @@ restore path at all. Here (SURVEY §5.4):
   format; each process for its own shards in the sharded format),
 - a stable schema independent of the parallelism strategy (a checkpoint
   written under FSDP restores under pure DP, a different mesh size — the
-  elastic-resize path — and vice versa),
+  elastic-resize path — and vice versa; likewise ZeRO-1's dp-sharded
+  ``opt_state`` — ``train/step.py shard_update`` — saves in logical
+  form and restores into either the sharded or the replicated layout,
+  ``tests/test_zero1.py``),
 - a restore path, including restore-into-sharded-layout.
 
 Two formats:

@@ -67,6 +67,14 @@ def decay_mask(params):
     return jax.tree_util.tree_map_with_path(keep, params)
 
 
+class _NonElementwise(optax.GradientTransformation):
+    """A transformation whose update math is NOT elementwise over leaves
+    (global-norm clip): ZeRO-1 update sharding (``train/step.py``)
+    must not run it on per-leaf shards."""
+
+    elementwise_update = False
+
+
 def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
                     weight_decay: float = 0.0, warmup_steps: int = 0,
                     clip_norm: float = 0.0, grad_accum: int = 1,
@@ -107,10 +115,19 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
         total = max(1, total // grad_accum)
 
     def wrap(tx):
+        non_elementwise = clip_norm > 0
         if clip_norm > 0:
             tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
         if grad_accum > 1:
             tx = optax.MultiSteps(tx, every_k_schedule=grad_accum)
+        if non_elementwise:
+            # marker consumed by make_step_fns' ZeRO-1 auto mode: the
+            # global-NORM clip couples every element of every leaf, so
+            # running this chain on per-leaf SHARDS (the sharded-update
+            # body) would clip against a shard-local norm — silently
+            # wrong. Accumulation (MultiSteps) and all the per-element
+            # transforms above shard fine.
+            tx = _NonElementwise(tx.init, tx.update)
         return tx
 
     if name == "adadelta":

@@ -29,9 +29,12 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_compute_pytorch_tpu.core.mesh import batch_sharding, use_mesh
+from distributed_compute_pytorch_tpu.core.mesh import (
+    batch_sharding, shard_map, use_manual_axes, use_mesh)
+from distributed_compute_pytorch_tpu.parallel import collectives as coll
 from distributed_compute_pytorch_tpu.parallel.api import (
     DataParallel, tree_shardings)
 
@@ -62,7 +65,8 @@ class TrainState:
 
 def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                   strategy=None, donate: bool = True, compute_dtype=None,
-                  augment=None):
+                  augment=None, shard_update: bool | None = None,
+                  quant_collectives: bool = False):
     """Build ``(init_fn, train_step, eval_step)`` for ``model`` on ``mesh``.
 
     ``strategy`` decides parameter layout (default pure DP = replicated,
@@ -72,9 +76,76 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     optional ``(x, rng) -> x`` transform (``ops/augment.py``) traced into the
     TRAIN step only — device-side augmentation, eval untouched. The returned
     functions are jit-compiled; train_step donates the state buffers.
+
+    ``shard_update`` — ZeRO-1 cross-replica weight-update sharding
+    (``parallel/collectives.py``; default ON when the strategy is
+    ``DataParallel`` and the dp world size > 1): instead of every replica
+    all-reducing full gradients and redundantly running the whole
+    O(params) update on fully replicated ``opt_state``, each gradient
+    leaf is reduce-scattered into a 1/N shard, the optimizer update runs
+    shard-local inside a ``shard_map`` over the dp axes (which is also
+    what lets ``adamw_fused``'s Pallas kernel run on the shard instead
+    of being replicated-only), and the updated params are all-gathered
+    back. ``opt_state`` is BORN sharded via ``init_fn``'s out_shardings
+    and stays sharded for the life of the run — per-chip optimizer HBM
+    drops by the dp-axis size. Param trajectories match the replicated
+    update to f32 reduction-order tolerance. Leaves too small or
+    indivisible stay replicated and pay the old update (byte-budget
+    rounding error). Pass ``False`` to force the replicated update.
+
+    ``quant_collectives`` — opt-in block-scaled int8 GRADIENT collectives
+    (EQuARX-motivated): the whole loss+grad+update runs inside one
+    shard_map manual over the dp axis, so the gradient cross-replica
+    reduction IS ``collectives.quantized_reduce_scatter`` (int8 wire
+    bytes, f32 accumulate) rather than the partitioner's exact psum.
+    Requires ``shard_update``, a single dp axis, a stateless model (no
+    BatchNorm-style cross-batch state — its stats would turn shard-local
+    inside the manual region) and no ``augment``; losses that are means
+    over fixed-size shards reproduce the exact-path loss, and gradients
+    differ by the collective's bounded quantization error
+    (tests/test_collectives.py).
     """
     strategy = strategy or DataParallel()
     fused_opt = hasattr(tx, "fused_apply")
+    dp_ax = coll.dp_axes(mesh)
+    dp_n = coll.dp_size(mesh)
+    elementwise = getattr(tx, "elementwise_update", True)
+    if shard_update is None:
+        zero1 = (isinstance(strategy, DataParallel) and dp_n > 1
+                 and elementwise)
+    else:
+        zero1 = bool(shard_update)
+        if zero1 and not elementwise:
+            # global-norm clip computes over EVERY element of every leaf;
+            # on shards it would clip against a shard-local norm
+            raise ValueError(
+                "shard_update cannot run a non-elementwise optimizer "
+                "chain (global-norm clip) on per-leaf shards; drop "
+                "--clip_norm or --shard_update")
+        if zero1 and not isinstance(strategy, DataParallel):
+            # FSDP/TP opt_state is already sharded by the parameter
+            # layout; ZeRO-1 is specifically the fix for REPLICATED
+            # parameter training
+            raise ValueError(
+                "shard_update applies to the DataParallel strategy only "
+                "(FSDP/ShardingRules already shard opt_state with the "
+                "params)")
+        if zero1 and dp_n <= 1:
+            zero1 = False
+    if quant_collectives:
+        if not zero1:
+            raise ValueError(
+                "quant_collectives requires shard_update (DataParallel, "
+                "dp world size > 1)")
+        if len(dp_ax) != 1:
+            raise ValueError(
+                f"quant_collectives needs a single dp axis for its "
+                f"all_to_all exchange; mesh has {dp_ax}")
+        if augment is not None:
+            raise ValueError(
+                "quant_collectives runs the step inside a dp-manual "
+                "shard_map where device-side augmentation would draw "
+                "shard-local masks; drop --augment or the quantized mode")
     # Interleaved layer STORAGE (parallel/pipeline.py): when the model
     # wants the Megatron interleaved schedule (virtual_stages > 1) on a
     # pipe mesh, the live TrainState keeps its blocks permuted into the
@@ -98,7 +169,10 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         # a pallas custom call is opaque to the GSPMD partitioner: under a
         # sharded parameter layout XLA would replicate (all-gather) every
         # leaf into the kernel, silently defeating FSDP/TP memory savings
-        # or OOMing — refuse loudly instead
+        # or OOMing — refuse loudly instead. (Under DataParallel +
+        # shard_update the kernel is no longer replicated-only: the
+        # ZeRO-1 shard_map body hands it explicit per-shard LOCAL arrays,
+        # so the partitioner never sees the custom call at all.)
         raise ValueError(
             "fused optimizers (adamw_fused) support replicated parameters "
             "(DataParallel) only; use --optimizer adamw with sharded "
@@ -121,11 +195,17 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
 
     def _state_shardings(state_shapes: TrainState) -> TrainState:
         repl = NamedSharding(mesh, P())
+        # ZeRO-1: opt_state is BORN in the update-shard layout (and stays
+        # there — the sharded update's out_specs keep it), so the 2x-params
+        # AdamW moments never exist replicated on any chip
+        opt = (coll.tree_update_shardings(state_shapes.opt_state, mesh)
+               if zero1 else
+               tree_shardings(strategy, state_shapes.opt_state, mesh))
         return TrainState(
             step=repl,
             params=tree_shardings(strategy, state_shapes.params, mesh),
             model_state=jax.tree.map(lambda _: repl, state_shapes.model_state),
-            opt_state=tree_shardings(strategy, state_shapes.opt_state, mesh),
+            opt_state=opt,
             rng=repl,
         )
 
@@ -162,6 +242,116 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     # batches to the batch axes, so jit sees fully-specified layouts and the
     # SPMD partitioner inserts the implied collectives.
 
+    def _local_update(g, o, p):
+        """Apply the optimizer to one (gradient, opt_state, params)
+        triple. On the replicated path these are full arrays; inside the
+        ZeRO-1 shard_map body they are the per-shard LOCAL arrays — every
+        transform in the supported chains is elementwise over leaves, so
+        the same code serves both (clip_by_global_norm is the known
+        non-elementwise exception; the trainer gates it off)."""
+        if fused_opt:
+            # single-pass fused optimizers produce new params directly —
+            # the update->apply_updates contract would cost one extra
+            # O(params) pass just to materialise deltas
+            return tx.fused_apply(g, o, p)
+        updates, new_o = tx.update(g, o, p)
+        return optax.apply_updates(p, updates), new_o
+
+    def _zero1_update(grads, opt_state, params):
+        """RS -> shard-local update -> AG (the weight-update-sharding
+        paper's transform, annotation-driven): the shard_map's in_specs
+        mark each leaf's 1/N update layout, so the partitioner
+        materialises the gradients' pending cross-replica psum AS a
+        reduce-scatter at the region boundary; the body updates the
+        shard (this is where ``adamw_fused``'s Pallas kernel runs
+        per-shard-local); the closing replicated constraint is the param
+        all-gather. ``opt_state`` goes in sharded and comes out sharded
+        — it never exists replicated."""
+        p_specs = coll.tree_update_specs(params, dp_n, dp_ax)
+        o_specs = coll.tree_update_specs(opt_state, dp_n, dp_ax)
+        body = shard_map(_local_update, mesh=mesh,
+                         in_specs=(p_specs, o_specs, p_specs),
+                         out_specs=(p_specs, o_specs),
+                         axis_names=set(dp_ax))
+        new_p, new_o = body(grads, opt_state, params)
+        repl = NamedSharding(mesh, P())
+        new_p = jax.tree.map(
+            lambda a: lax.with_sharding_constraint(a, repl), new_p)
+        return new_p, new_o
+
+    def _quant_step(state: TrainState, x, y, step_rng):
+        """Opt-in quantized-gradient ZeRO-1 step: loss, backward and
+        update all inside ONE shard_map manual over the single dp axis,
+        so each rank holds its honest per-shard gradient and the
+        cross-replica reduction IS the block-scaled int8
+        ``quantized_reduce_scatter`` (int8 + per-block f32 scales on the
+        wire, f32 accumulate; bf16 for tiny chunks; exact psum for
+        leaves that stay replicated). Params enter replicated (no comm),
+        updated shards all-gather back inside the region."""
+        ax = dp_ax[0]
+        params, opt_state = state.params, state.opt_state
+        p_specs = coll.tree_update_specs(params, dp_n, dp_ax)
+        o_specs = coll.tree_update_specs(opt_state, dp_n, dp_ax)
+        # the key travels as raw data: key-dtype arrays predate legacy
+        # shard_map's input handling on older jax
+        rng_data = jax.random.key_data(step_rng)
+
+        def body(p, o, xs, ys, rd):
+            rng = jax.random.wrap_key_data(rd)
+            if hasattr(model, "train_loss"):
+                def local_loss(pp):
+                    return model.train_loss(_cast_params(pp),
+                                            state.model_state, xs, ys,
+                                            rng=rng)
+            else:
+                def local_loss(pp):
+                    out, _ = model.apply(_cast_params(pp),
+                                         state.model_state, xs,
+                                         train=True, rng=rng)
+                    return model.loss_fn(out, ys), None
+            (loss, _), g = jax.value_and_grad(local_loss,
+                                              has_aux=True)(p)
+            # global-mean loss/grads = mean of the per-shard means (the
+            # feeder guarantees equal-size shards)
+            loss = lax.psum(loss, ax) / dp_n
+
+            def reduce_leaf(gl, spec):
+                d = coll.spec_shard_dim(spec)
+                if d is None:
+                    return lax.psum(gl, ax) / dp_n
+                return coll.quantized_reduce_scatter(gl, ax, dp_n,
+                                                     dim=d) / dp_n
+
+            g = jax.tree.map(reduce_leaf, g, p_specs)
+
+            def slice_leaf(pl, spec):
+                # params entered the region replicated (full local
+                # copies, zero comm); the update consumes the shard
+                d = coll.spec_shard_dim(spec)
+                return pl if d is None else coll.shard_slice(pl, ax, dp_n,
+                                                             dim=d)
+
+            new_p, new_o = _local_update(g, o,
+                                         jax.tree.map(slice_leaf, p,
+                                                      p_specs))
+
+            def gather_leaf(pl, spec):
+                d = coll.spec_shard_dim(spec)
+                return pl if d is None else coll.all_gather(pl, ax, dim=d)
+
+            new_p = jax.tree.map(gather_leaf, new_p, p_specs)
+            return new_p, new_o, loss
+
+        repl_p = jax.tree.map(lambda _: P(), params)
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(repl_p, o_specs, P(ax), P(ax), P()),
+                       out_specs=(repl_p, o_specs, P()),
+                       axis_names={ax})
+        # use_manual_axes: the model's internal layout pins (constrain /
+        # constrain_activations) must drop the now-manual dp axis
+        with use_mesh(mesh), use_manual_axes((ax,)), _layout_ctx():
+            return fn(params, opt_state, x, y, rng_data)
+
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, x, y):
         """One optimization step == reference ``train`` body (``main.py:57-63``)."""
@@ -187,22 +377,28 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                 loss = model.loss_fn(out, y)
                 return loss, new_mstate
 
-        # trace-time mesh context: lets layers (ring attention) find the
-        # mesh; the layout context tells pipeline_blocks the blocks are
-        # stored pre-interleaved (no-op otherwise)
-        with use_mesh(mesh), _layout_ctx():
-            (loss, new_mstate), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
-        if fused_opt:
-            # single-pass fused optimizers produce new params directly —
-            # the update->apply_updates contract would cost one extra
-            # O(params) pass just to materialise deltas
-            new_params, new_opt_state = tx.fused_apply(
-                grads, state.opt_state, state.params)
+        if quant_collectives:
+            if jax.tree_util.tree_leaves(state.model_state):
+                raise ValueError(
+                    "quant_collectives requires a stateless model: "
+                    "cross-batch statistics (BatchNorm) would become "
+                    "shard-local inside the dp-manual region")
+            new_params, new_opt_state, loss = _quant_step(state, x, y,
+                                                          step_rng)
+            new_mstate = state.model_state
         else:
-            updates, new_opt_state = tx.update(grads, state.opt_state,
-                                               state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            # trace-time mesh context: lets layers (ring attention) find
+            # the mesh; the layout context tells pipeline_blocks the
+            # blocks are stored pre-interleaved (no-op otherwise)
+            with use_mesh(mesh), _layout_ctx():
+                (loss, new_mstate), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(state.params)
+            if zero1:
+                new_params, new_opt_state = _zero1_update(
+                    grads, state.opt_state, state.params)
+            else:
+                new_params, new_opt_state = _local_update(
+                    grads, state.opt_state, state.params)
         new_state = state.replace(
             step=state.step + 1, params=new_params,
             model_state=new_mstate, opt_state=new_opt_state)
@@ -268,6 +464,17 @@ def state_layout_transforms(model, tx, mesh: Mesh):
     """``(to_logical, to_storage)`` converters between the live training
     state's layer layout and the persistent LOGICAL layout — or ``None``
     when they coincide (no interleaved storage in play).
+
+    ZeRO-1 update sharding needs no VALUE transform here: the sharded
+    ``opt_state`` is a device LAYOUT of the same logical arrays, so the
+    checkpoint layer round-trips it by construction — the v1 save
+    gathers leaves to their logical form, the v2 sharded save writes
+    per-shard spans reassembled under any target layout, and restore
+    places leaves straight into whatever shardings the template carries
+    (sharded -> replicated and back; pinned in tests/test_zero1.py).
+    When interleaved storage IS in play, the converters below preserve
+    each leaf's live sharding — including ZeRO-1-sharded optimizer
+    leaves — via the memoized ``out_shardings``.
 
     The trainer calls ``to_logical`` on the state it hands to checkpoint
     saves and ``to_storage`` on what restore returns, so every artifact

@@ -115,7 +115,8 @@ class Trainer:
         self.init_fn, self.train_step, self.eval_step = make_step_fns(
             self.model, self.tx, self.mesh, self.strategy,
             donate=config.donate, compute_dtype=compute_dtype,
-            augment=augment)
+            augment=augment, shard_update=self._resolve_shard_update(),
+            quant_collectives=config.quant_collectives)
         # interleaved-pipeline runs keep the LIVE state's blocks in the
         # strided storage layout; checkpoints stay logical — these
         # converters sit at the save/restore boundaries (None otherwise)
@@ -218,6 +219,43 @@ class Trainer:
              f" | model: {config.model} | dataset: {self.train_data.name}")
 
     # ------------------------------------------------------------------
+
+    def _resolve_shard_update(self):
+        """Map the config's 'auto'/'on'/'off' knob to make_step_fns'
+        tri-state, with the known non-elementwise gate: the ZeRO-1 body
+        runs the optimizer on per-leaf SHARDS, and clip_by_global_norm
+        would compute a shard-local norm there — silently wrong — so a
+        clip-bearing chain falls back to the replicated update."""
+        cfg = self.config
+        mode = cfg.shard_update
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"--shard_update must be auto|on|off, "
+                             f"got {mode!r}")
+        if mode == "off":
+            return False
+        if cfg.clip_norm > 0:
+            if mode == "on":
+                raise ValueError(
+                    "--shard_update on is incompatible with --clip_norm: "
+                    "the global-gradient-norm clip is not elementwise "
+                    "over shards")
+            from distributed_compute_pytorch_tpu.parallel import (
+                collectives)
+            from distributed_compute_pytorch_tpu.parallel.api import (
+                DataParallel)
+            if (isinstance(self.strategy, DataParallel)
+                    and collectives.dp_size(self.mesh) > 1):
+                log0("NOTE: --clip_norm > 0 disables ZeRO-1 update "
+                     "sharding (global-norm clip is not shard-local); "
+                     "running the replicated update")
+            return False
+        from distributed_compute_pytorch_tpu.parallel.api import (
+            DataParallel)
+        if mode == "on" and not isinstance(self.strategy, DataParallel):
+            raise ValueError(
+                "--shard_update on requires the DataParallel strategy "
+                "(FSDP/TP layouts already shard opt_state)")
+        return True if mode == "on" else None
 
     def _pick_strategy(self):
         """Parameter-layout strategy from the mesh spec — the one-knob

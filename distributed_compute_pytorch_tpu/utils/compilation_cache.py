@@ -15,9 +15,25 @@ import os
 
 def enable(cache_dir: str) -> None:
     """Turn on the persistent compile cache (idempotent, safe pre/post
-    backend init)."""
+    backend init).
+
+    CPU-pinned runs on jax 0.4.x are a hard NO-OP: executables
+    DESERIALIZED from the persistent cache segfault the 0.4.x CPU
+    backend when another thread device_puts concurrently (reproduced
+    deterministically on 0.4.37: a cache-hit donated train step with
+    the DeviceFeeder's prefetch thread live crashes the process —
+    prefetch=0 on the same run is clean — and it aborted the tier-1
+    suite at the first Trainer resume test, taking every
+    alphabetically-later test with it). CPU compiles are cheap; the
+    cache's value is the relayed-TPU remote compile service, where the
+    deserialization path is not affected.
+    """
     import jax
 
+    pinned_cpu = "cpu" in (os.environ.get("JAX_PLATFORMS") or
+                           jax.config.jax_platforms or "").lower()
+    if pinned_cpu and jax.__version_info__ < (0, 5):
+        return
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # cache everything: the default thresholds skip small/fast programs,

@@ -325,3 +325,83 @@ def test_segment_size_invariance():
         outs.append(cb.serve([Request(list(r.tokens), r.max_new)
                               for r in reqs]))
     assert outs[0] == outs[1] == outs[2]
+
+
+# ----------------------------------------------- MoE admission capacity
+
+
+def test_moe_admission_capacity_matches_standalone_when_binding():
+    """ADVICE r5's capacity divergence, closed: admission prefills over
+    the fixed ``prompt_buf`` window, but its expert queue capacity is
+    the REAL prompt length's (``moe_capacity``, static per admission) —
+    so with a BINDING eval capacity (ecf=1.0, far below the window's),
+    the admission-written K/V equal the standalone prefill's at every
+    layer (layer>0 K/V see layer-0's MoE outputs, so a routing
+    difference would show). The old window-derived capacity provably
+    diverges on the same input — asserted too, so this test bites."""
+    cfg = dataclasses.replace(MoETransformerConfig.tiny(), max_seq_len=128,
+                              capacity_factor=1.0, eval_capacity_factor=1.0,
+                              top_k=1)
+    model = MoETransformerLM(cfg)
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(11)
+    tokens = [int(t) for t in rng.integers(0, 256, 12)]
+    head = tokens[:-1]
+    Tb = 16
+    cb = ContinuousBatcher(model, params, slots=2, t_max=128,
+                           prompt_buf=Tb, segment=3)
+    prompt = np.zeros((1, Tb), np.int32)
+    pmask = np.zeros((1, Tb), np.float32)
+    prompt[0, Tb - len(head):] = head
+    pmask[0, Tb - len(head):] = 1.0
+
+    def admit(cap):
+        caches = jax.tree.map(jnp.zeros_like, cb._caches)
+        sm = jnp.zeros_like(cb._slot_mask)
+        return cb._admit_c(cb.params, caches, sm, jnp.int32(0),
+                           jnp.asarray(prompt), jnp.asarray(pmask),
+                           moe_capacity=cap)[0]
+
+    cap = model._block().prefill_capacity(len(tokens))
+    assert cap < model._block().prefill_capacity(Tb)   # capacity binds
+    new_caches = admit(cap)
+    old_caches = admit(None)              # the old window-derived path
+
+    from distributed_compute_pytorch_tpu.infer import prefill
+    _, solo_caches = jax.jit(lambda p, t: prefill(model, p, t, 32))(
+        params, jnp.asarray([tokens], jnp.int32))
+
+    old_diverges = False
+    for li in range(cb._n_layers):
+        solo_kv = np.asarray(solo_caches[li]["kv"])[:, 0, :, :len(head)]
+        new_kv = np.asarray(new_caches[li]["kv"])[:, 0, :,
+                                                  Tb - len(head):Tb]
+        old_kv = np.asarray(old_caches[li]["kv"])[:, 0, :,
+                                                  Tb - len(head):Tb]
+        np.testing.assert_allclose(new_kv, solo_kv, atol=1e-5)
+        old_diverges |= bool(np.abs(old_kv - solo_kv).max() > 1e-3)
+    assert old_diverges, ("window-derived capacity routed identically — "
+                          "the scenario no longer exercises the fix")
+
+
+def test_moe_no_drop_contract_exact_parity():
+    """The documented no-drop contract, kept as a test: with eval
+    capacity sized so NO token is capacity-dropped on either path
+    (generous ecf), served outputs equal standalone generation token
+    for token — including the deferred last prompt token (serve routes
+    it in a full-capacity decode tick; the standalone prefill keeps it
+    because capacity never binds)."""
+    cfg = dataclasses.replace(MoETransformerConfig.tiny(), max_seq_len=128,
+                              eval_capacity_factor=4.0)
+    model = MoETransformerLM(cfg)
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(13)
+    reqs = _requests(rng, 4, min_new=4, max_new=6)
+    cb = ContinuousBatcher(model, params, slots=2, t_max=128,
+                           prompt_buf=10, segment=3)
+    outs = cb.serve(reqs)
+    for i, (req, out) in enumerate(zip(reqs, outs)):
+        solo = generate(model, params,
+                        jnp.asarray([req.tokens], jnp.int32), req.max_new)
+        want = [int(t) for t in np.asarray(solo)[0, len(req.tokens):]]
+        assert out == want, (i, out, want)
