@@ -18,9 +18,14 @@ test:
 bench:
 	python bench.py
 
-# CPU-sized end-to-end run of the ZeRO-1 update-sharding bench stage
-# (tiny model, faked 4-device CPU mesh): exercises the bench plumbing —
-# sharded init, both step programs, the opt-HBM byte meter — in tier-1
-# time budgets, and fails if sharding doesn't shrink per-chip opt state
+# CPU-sized end-to-end runs of the bench plumbing (tiny models, faked
+# multi-device CPU meshes) inside tier-1 time budgets:
+# - zero1: sharded init, both step programs, the opt-HBM byte meter;
+#   fails if sharding doesn't shrink per-chip opt state
+# - serve: the mesh-sharded continuous-batching loop's transport
+#   counters; fails unless each segment costs exactly one device->host
+#   fetch issued AFTER the next segment's dispatch (overlap), admission
+#   waves are single multi-row prefills, and the KV cache lands sharded
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --zero1-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-smoke

@@ -16,13 +16,23 @@ line, either
     {"tokens": [12,7,90], "max_new": 16}   # per-request budget
 
 or, with ``--tokenizer``, ``{"text": "..."}`` lines / raw text lines.
-Prints one JSON line per request, in input order: {"prompt": [...],
-"new": [...]} (+ "text" when a tokenizer is given).
+JSON requests may also carry per-request sampling settings
+(``"temperature"``, ``"top_k"``, ``"top_p"``, ``"seed"``), overriding
+the CLI defaults — requests with different settings decode side by
+side in the same compiled segment. Prints one JSON line per request,
+in input order: {"prompt": [...], "new": [...]} (+ "text" when a
+tokenizer is given).
+
+``--mesh`` serves SHARDED (same spec language as ``dcp-generate``):
+the checkpoint restores straight into the mesh layout, cache rows
+shard over the batch axes and KV heads over ``tensor`` — ``--slots``
+must then be a multiple of the batch-axis product.
 
 Example:
 
     dcp-serve --ckpt_path ck.npz --model llama --model_preset tiny \\
-        --requests prompts.txt --slots 8 --max_new_tokens 32
+        --requests prompts.txt --slots 8 --max_new_tokens 32 \\
+        --mesh data=2,tensor=2 --temperature 0.8 --top_p 0.95
 """
 
 from __future__ import annotations
@@ -32,7 +42,10 @@ import json
 import sys
 
 
-def _read_requests(path: str, tok, default_new: int):
+def _read_requests(path: str, tok, default_new: int, defaults: dict):
+    """Parse the request file into dicts; JSON lines may override the
+    CLI's sampling ``defaults`` (temperature/top_k/top_p/seed) per
+    request."""
     lines = (sys.stdin if path == "-" else open(path)).read().splitlines()
     out = []
     for i, line in enumerate(lines):
@@ -40,6 +53,7 @@ def _read_requests(path: str, tok, default_new: int):
         if not line:
             continue
         text = None
+        sampling = dict(defaults)
         if line.startswith("{"):
             try:
                 obj = json.loads(line)
@@ -57,6 +71,15 @@ def _read_requests(path: str, tok, default_new: int):
             if not isinstance(new, int) or new < 1:
                 raise SystemExit(f"requests line {i + 1}: max_new must "
                                  f"be a positive integer, got {new!r}")
+            for k in ("temperature", "top_k", "top_p", "seed"):
+                if k in obj:
+                    sampling[k] = obj[k]
+            if sampling["temperature"] == 0.0 and (
+                    sampling["top_k"] is not None
+                    or sampling["top_p"] is not None):
+                raise SystemExit(
+                    f"requests line {i + 1}: top_k/top_p require "
+                    f"temperature > 0")
         elif tok is not None:
             text, ids, new = line, None, default_new
         else:
@@ -74,7 +97,7 @@ def _read_requests(path: str, tok, default_new: int):
             ids = tok.encode(text)
         if not ids:
             raise SystemExit(f"requests line {i + 1}: empty prompt")
-        out.append((ids, new))
+        out.append({"tokens": ids, "max_new": new, **sampling})
     if not out:
         raise SystemExit("no requests")
     return out
@@ -108,11 +131,34 @@ def main(argv=None) -> int:
                         "and decode outputs back to text")
     p.add_argument("--quantize", default=None, choices=("int8",),
                    help="weight-only int8 serving")
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec for SHARDED serving (e.g. "
+                        "data=2,tensor=2): cache rows shard over the "
+                        "batch axes, kv heads over tensor; --slots must "
+                        "be a multiple of the batch-axis product")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="default sampling temperature (0 = greedy); "
+                        "JSON requests may override per request")
+    p.add_argument("--top_k", type=int, default=None,
+                   help="default top-k truncation (needs temperature>0)")
+    p.add_argument("--top_p", type=float, default=None,
+                   help="default nucleus truncation (needs temperature>0)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="base sampling seed; request i uses seed+i "
+                        "(default: i) so the whole file is deterministic")
+    p.add_argument("--admit_policy", default="fifo",
+                   choices=("fifo", "skip_fit"),
+                   help="admission order: strict FIFO (fairness: no "
+                        "request is leapfrogged) or skip-fit (a free row "
+                        "takes the first queued request that fits)")
     p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
     args = p.parse_args(argv)
 
     if args.max_new_tokens < 1:
         raise SystemExit("--max_new_tokens must be >= 1")
+    if args.temperature == 0.0 and (args.top_k is not None
+                                    or args.top_p is not None):
+        raise SystemExit("--top_k/--top_p require --temperature > 0")
     if args.force_cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -121,9 +167,9 @@ def main(argv=None) -> int:
     from distributed_compute_pytorch_tpu.serve import (
         ContinuousBatcher, Request)
 
-    model, params, _ = load_model_and_params(
+    model, params, mesh = load_model_and_params(
         args.model, args.model_preset, args.vocab_size, args.max_seq_len,
-        args.ckpt_path, quantize=args.quantize)
+        args.ckpt_path, mesh_spec=args.mesh, quantize=args.quantize)
 
     tok = None
     if args.tokenizer is not None:
@@ -133,22 +179,25 @@ def main(argv=None) -> int:
         check_tokenizer_vocab(tok, model)
         if args.eos_id is None:
             args.eos_id = tok.eos_id
-    reqs = _read_requests(args.requests, tok, args.max_new_tokens)
+    defaults = {"temperature": args.temperature, "top_k": args.top_k,
+                "top_p": args.top_p, "seed": None}
+    reqs = _read_requests(args.requests, tok, args.max_new_tokens,
+                          defaults)
 
     vocab = model.config.vocab_size
-    bad = [t for ids, _ in reqs for t in ids if not 0 <= t < vocab]
+    bad = [t for r in reqs for t in r["tokens"] if not 0 <= t < vocab]
     if bad:
         raise SystemExit(f"prompt ids {bad[:8]} outside vocab [0, {vocab})")
     check_eos(args.eos_id, vocab)
 
     cap = getattr(model.config, "max_seq_len", None)
     if cap is not None:
-        over = [(ids, n) for ids, n in reqs if len(ids) + n > cap]
+        over = [r for r in reqs if len(r["tokens"]) + r["max_new"] > cap]
         if over:
             raise SystemExit(
                 f"{len(over)} request(s) exceed the model's "
                 f"max_seq_len={cap} (prompt+max_new); shrink them")
-    prompt_buf = args.prompt_buf or max(len(ids) for ids, _ in reqs)
+    prompt_buf = args.prompt_buf or max(len(r["tokens"]) for r in reqs)
     if args.t_max is None:
         # horizon: positions are PER ROW (rows recycle in place), so
         # t_max only needs to bound the single largest request — the
@@ -158,15 +207,26 @@ def main(argv=None) -> int:
         # legitimately exceed the model's max_seq_len — only each row's
         # LOGICAL positions are capacity-bound (checked above).
         S = args.segment
-        t_max = prompt_buf + max(-(-n // S) * S for _, n in reqs)
+        t_max = prompt_buf + max(-(-r["max_new"] // S) * S for r in reqs)
     else:
         t_max = args.t_max
     cb = ContinuousBatcher(model, params, slots=args.slots, t_max=t_max,
                            prompt_buf=prompt_buf, segment=args.segment,
-                           eos_id=args.eos_id)
-    outs = cb.serve([Request(list(ids), n) for ids, n in reqs])
-    for (ids, _), new in zip(reqs, outs):
-        rec = {"prompt": ids, "new": new}
+                           eos_id=args.eos_id, mesh=mesh,
+                           admit_policy=args.admit_policy)
+
+    def req_seed(i, r):
+        if r["seed"] is not None:
+            return r["seed"]
+        return None if args.seed is None else args.seed + i
+
+    outs = cb.serve([
+        Request(list(r["tokens"]), r["max_new"],
+                temperature=r["temperature"], top_k=r["top_k"],
+                top_p=r["top_p"], seed=req_seed(i, r))
+        for i, r in enumerate(reqs)])
+    for r, new in zip(reqs, outs):
+        rec = {"prompt": r["tokens"], "new": new}
         if tok is not None:
             rec["text"] = tok.decode(new)
         print(json.dumps(rec))

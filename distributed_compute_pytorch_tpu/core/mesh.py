@@ -324,6 +324,24 @@ def constrain(x, spec: P):
         x, jax.sharding.NamedSharding(target, P(*cleaned)))
 
 
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    """``NamedSharding(mesh, spec)`` with axes absent from the mesh (or
+    size 1) dropped from the spec — the out-of-jit counterpart of
+    :func:`constrain`, for ``jax.device_put`` of host-built arrays into
+    their ideal layout (the serving loop's persistent KV caches,
+    ``serve.ContinuousBatcher``). Callers name the full ideal spec
+    unconditionally and get whatever subset the mesh can express."""
+    def clean(entry):
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry
+                         if a in mesh.axis_names and mesh.shape[a] > 1)
+            return kept or None
+        return (entry if (entry in mesh.axis_names
+                          and mesh.shape[entry] > 1) else None)
+
+    return NamedSharding(mesh, P(*(clean(a) for a in spec)))
+
+
 def constrain_replicated(x):
     """Pin ``x`` fully replicated when a mesh context is active (no-op
     off-mesh and inside manual regions).

@@ -164,6 +164,50 @@ def _sample(logits, temperature: float, rng, top_k: int | None = None,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_rows(logits, temperature, top_k, top_p, keys):
+    """Vectorised PER-ROW sampling — the serving loop's counterpart of
+    :func:`_sample` (``serve.ContinuousBatcher`` mixes requests with
+    different sampling settings in one compiled segment, so every knob
+    is a ``[B]`` vector instead of a static scalar).
+
+    Args:
+      logits: ``[B, vocab]``.
+      temperature: ``[B]`` float; 0 = greedy for that row (rng unused).
+      top_k: ``[B]`` int32; 0 = no top-k truncation for that row.
+      top_p: ``[B]`` float; >= 1 = no nucleus truncation for that row.
+      keys: ``[B]`` PRNG keys (one independent stream per row).
+
+    Static-shape like ``_sample`` (sort + mask, never a dynamic-size
+    gather): per-row k/p cutoffs come from the row's sorted
+    distribution via ``take_along_axis`` at a TRACED index, so one
+    compiled program serves every combination of per-row settings.
+    Greedy rows (``temperature == 0``) take the plain argmax — exactly
+    ``_sample(…, 0.0)`` — so a greedy request served next to sampling
+    requests keeps its standalone-parity tokens.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / jnp.maximum(temperature,
+                                                  1e-6)[:, None]
+    desc = jnp.sort(lg, axis=-1)[:, ::-1]
+    # top-k: the row's k-th highest (scaled) logit is the cutoff
+    kth = jnp.take_along_axis(desc, jnp.clip(top_k - 1, 0, V - 1)[:, None],
+                              axis=-1)
+    lg = jnp.where((top_k > 0)[:, None] & (lg < kth), -jnp.inf, lg)
+    # nucleus over the (top-k-masked) distribution: keep the smallest
+    # sorted prefix reaching p (first token always stays: shifted cumsum)
+    desc = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    cut_idx = jnp.clip(
+        jnp.sum((cum < top_p[:, None]).astype(jnp.int32), axis=-1,
+                keepdims=True) - 1, 0, V - 1)
+    cutoff = jnp.take_along_axis(desc, cut_idx, axis=-1)
+    lg = jnp.where((top_p < 1.0)[:, None] & (lg < cutoff), -jnp.inf, lg)
+    sampled = jax.vmap(jax.random.categorical)(keys, lg).astype(jnp.int32)
+    return jnp.where(temperature == 0.0, greedy, sampled)
+
+
 def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                      temperature: float = 0.0, eos_id: int | None = None,
                      top_k: int | None = None, top_p: float | None = None,

@@ -164,7 +164,7 @@ class MoELayer:
             xpad, src[:, :E * C, None], axis=1)                 # [G, E*C, d]
         return xdisp.reshape(G, E, C, d), picks
 
-    def apply(self, params, x, token_mask=None):
+    def apply(self, params, x, token_mask=None, capacity_rows=None):
         """``x [B, T, d]`` -> ``(y [B, T, d], aux)`` where ``aux`` carries
         the load-balancing and router-z losses (fold into the objective as
         ``loss + lb_weight*aux['lb_loss'] + z_weight*aux['z_loss']``).
@@ -176,7 +176,17 @@ class MoELayer:
         positions' outputs are never consumed). The generation prefill
         passes its prompt mask here; masked tokens count as neither kept
         nor routed in the aux stats, so ``dropped_fraction`` under a mask
-        is over-counted by the pad fraction (inference discards aux)."""
+        is over-counted by the pad fraction (inference discards aux).
+
+        ``capacity_rows`` (``[G]`` int32, traced): PER-GROUP queue
+        capacities, each clamped by the static capacity ``C`` that shapes
+        the dispatch one-hots. The serving loop's BATCHED admission
+        (``serve.ContinuousBatcher``) routes each cache row as its own
+        group with the capacity its REAL prompt length implies — one
+        compiled multi-row prefill whose every row keeps exact parity
+        with a standalone global-group prefill at that row's capacity
+        (the static ``C`` is the wave's max; a row's excess one-hot
+        columns past its own capacity are simply never kept)."""
         B, T, d = x.shape
         E = self.num_experts
         if self.top_k not in (1, 2):
@@ -187,6 +197,10 @@ class MoELayer:
             raise ValueError(f"group_size {Ng} does not divide {N} tokens")
         G = N // Ng
         C = self.capacity(Ng)
+        # per-group effective capacity: keep-decisions use the row's own
+        # capacity; the static C only shapes the one-hot queue axis
+        cap_eff = (C if capacity_rows is None
+                   else jnp.minimum(capacity_rows, C)[:, None, None])
         xg = x.reshape(G, Ng, d)
         mask_g = (None if token_mask is None
                   else token_mask.reshape(G, Ng).astype(jnp.float32))
@@ -241,7 +255,7 @@ class MoELayer:
                 oh = oh * mask_g[..., None]
             pos = (jnp.cumsum(oh, axis=1) - oh) * oh           # [G, Ng, E]
             pos = pos + prio_count[:, None, :] * oh
-            keep = (pos < C) * oh
+            keep = (pos < cap_eff) * oh
             gate = jnp.sum(probs * oh, -1)                     # [G, Ng]
             return oh, pos, keep, gate
 
@@ -418,19 +432,25 @@ class MoEBlock:
                         param_dtype=c.param_dtype)
 
     def _moe_infer(self, n_tokens: int, decode: bool,
-                   capacity_override: int | None = None) -> MoELayer:
+                   capacity_override: int | None = None,
+                   group_size: int | None = None) -> MoELayer:
         """Inference-routing layer (argmax selection; class docstring):
         full-capacity single group for decode ticks, grouped +
         eval-capacity for prefill. ``capacity_override`` (the serving
-        admission path) pins the queue capacity explicitly — and forces
-        a single global group, because the override expresses "route
-        these ``n_real`` tokens as a standalone global-group prefill
-        would" and per-group boundaries over a padded window cannot line
-        up with the unpadded run's."""
+        admission path) pins the queue capacity explicitly — and, absent
+        an explicit ``group_size``, forces a single global group, because
+        the override expresses "route these ``n_real`` tokens as a
+        standalone global-group prefill would" and per-group boundaries
+        over a padded window cannot line up with the unpadded run's.
+        The serving loop's BATCHED admission passes ``group_size`` = its
+        prompt window so each cache row is its own group (with ITS
+        capacity via ``MoELayer.apply(capacity_rows=…)``) — rows never
+        share expert queues, which is what keeps every row's routing
+        identical to its standalone prefill's."""
         c = self.config
-        group = None
-        if (capacity_override is None and not decode and c.moe_group_size
-                and n_tokens % c.moe_group_size == 0):
+        group = group_size
+        if (group is None and capacity_override is None and not decode
+                and c.moe_group_size and n_tokens % c.moe_group_size == 0):
             group = c.moe_group_size
         ecf = (c.eval_capacity_factor
                if c.eval_capacity_factor is not None
@@ -464,7 +484,8 @@ class MoEBlock:
         }
 
     def apply(self, p, x, *, rng=None, train: bool = False, kv_mask=None,
-              manual_axes=(), kv_sink=None, moe_capacity=None):
+              manual_axes=(), kv_sink=None, moe_capacity=None,
+              moe_capacity_rows=None):
         from distributed_compute_pytorch_tpu.models.transformer import (
             attention_sublayer)
         c = self.config
@@ -485,11 +506,17 @@ class MoEBlock:
             # they can never evict a real token when capacity binds.
             # ``moe_capacity`` (static int; the serving admission) pins
             # the queue capacity to the REAL token count's instead of
-            # deriving it from the padded window size.
+            # deriving it from the padded window size. A batched
+            # admission wave (B > 1 rows) routes each row as its own
+            # group at its own capacity (``moe_capacity_rows`` [B],
+            # traced; the static value is the wave max) — for B == 1,
+            # group_size == T is exactly the old single global group.
             B, T, _ = h.shape
-            moe = self._moe_infer(B * T, decode=False,
-                                  capacity_override=moe_capacity)
-            y, aux = moe.apply(p["moe"], h, token_mask=kv_mask)
+            moe = self._moe_infer(
+                B * T, decode=False, capacity_override=moe_capacity,
+                group_size=(T if moe_capacity is not None else None))
+            y, aux = moe.apply(p["moe"], h, token_mask=kv_mask,
+                               capacity_rows=moe_capacity_rows)
         else:
             y, aux = self._moe().apply(p["moe"], h)
         return x + y, aux

@@ -10,9 +10,9 @@ instead, with everything the TPU touches remaining static-shaped:
 
 - **Decode segments**: one jitted ``lax.scan`` of ``segment`` ticks over
   all slots (the same per-tick math as ``infer.py`` — ``decode_step``
-  per block, in-place cache writes, greedy sample). Caches/tokens carry
-  ACROSS calls as donated buffers, so consecutive segments reuse the
-  same compiled program at zero re-trace cost.
+  per block, in-place cache writes, per-row sampling). Caches/tokens
+  carry ACROSS calls as donated buffers, so consecutive segments reuse
+  the same compiled program at zero re-trace cost.
 - **Per-row positions**: every cache row advances an INDEPENDENT write
   position (``decode_step`` takes a ``[B]`` position vector; the Pallas
   slot write is per-row — ``ops/pallas/cache_update.py::
@@ -22,79 +22,143 @@ instead, with everything the TPU touches remaining static-shaped:
   shared ``prompt_buf`` burn — and rewinds that row to slot
   ``prompt_buf - 1``. ``t_max`` is therefore a PER-REQUEST length
   bound, not a session-wide tick budget: rows recycle indefinitely on
-  the same compiled programs and a session never exhausts. (The
-  previous design kept one global lockstep position, which made
-  ``t_max`` a shared horizon that every admission and every tick
-  drained — mixed-length streams collapsed cache utilization and
-  ``serve`` could raise mid-run, discarding finished work.)
-- **Admission**: a finished row takes the next queued prompt. The new
-  prompt — all tokens but its last, left-padded into the fixed
-  ``prompt_buf`` window at the row's offset 0 — is prefilled; the LAST
-  prompt token becomes the row's current token, consumed by the next
-  segment's first tick at slot ``prompt_buf`` exactly as standalone
-  generation would (and keeping admission fetch-free — see
-  ``_admit_impl``). Per-row ``slot_mask``
-  rows hide the pad slots; the per-row position mask hides everything
-  the row's previous occupant left beyond the live position.
-  Positions stay exact per family: learned-position models embed
-  LOGICAL positions (0..n-1 per row), rope models rope at ABSOLUTE
-  PER-ROW slots (the ``positions`` override in ``LlamaBlock.apply`` at
-  admission, the ``[B]`` pos vector at decode), and RoPE scores depend
-  only on within-row slot differences, which the fixed window offset
-  preserves.
-- **Host scheduler**: a plain queue. It admits into free rows, runs a
-  segment, harvests each row's tokens (trimming at eos/budget), and
-  re-admits — requests at MIXED lengths stream through a statically
-  shaped program with no bucketing, no recompilation, and no session
-  horizon.
+  the same compiled programs and a session never exhausts.
+- **Batched admission**: ALL pending prompts that fit free rows are
+  stacked into ONE compiled multi-row prefill per admission wave (a
+  ``[K, prompt_buf]`` left-padded batch scattered into the K freed
+  cache rows) instead of a batch-1 call per request — k admissions cost
+  one dispatch, not k. Each prompt — all tokens but its last — is
+  prefilled; the LAST prompt token becomes the row's current token,
+  consumed by the next segment's first tick at slot ``prompt_buf``
+  exactly as standalone generation would (and keeping admission
+  fetch-free — see ``_admit_impl``). Per-row ``slot_mask`` rows hide
+  the pad slots; the per-row position mask hides everything the row's
+  previous occupant left beyond the live position. Positions stay
+  exact per family: learned-position models embed LOGICAL positions
+  (0..n-1 per row), rope models rope at ABSOLUTE PER-ROW slots, and
+  RoPE scores depend only on within-row slot differences, which the
+  fixed window offset preserves. (The wave size ``K`` is a compiled
+  shape — distinct wave sizes compile once each, bounded by ``slots``.)
+- **Mesh composition**: pass ``mesh=`` (same contract as
+  ``infer.make_generate_fn``) and the WHOLE serving session is sharded:
+  cache rows over the batch axes (``data``/``fsdp``), KV heads over
+  ``tensor`` (GQA: ``tensor`` must divide ``num_kv_heads``), expert
+  FFNs over ``expert`` — the layout ``infer._CACHE_SPEC`` names, the
+  same one the params trained under. The admission prefill computes at
+  its own (batch-K, tensor/expert-sharded) layout and its K/V output is
+  RESHARDED into the row-sharded cache layout by the scatter that
+  writes the freed rows — the portable-redistribution move
+  (arXiv:2112.01075): XLA inserts the collective the two layouts imply,
+  and no cache is ever gathered to one device.
+- **Overlapped host scheduler**: a plain queue, with the single
+  device->host fetch per segment (the token harvest, ~130 ms on the
+  relayed transport) OVERLAPPED with the next segment's execution:
+  segment N+1 is dispatched BEFORE segment N's tokens are fetched.
+  This is sound because rows are computationally independent — a row's
+  tokens depend only on its own cache, never on when its neighbours
+  were admitted — and budget completion is host-known (a row with
+  ``remaining <= segment`` at dispatch is parked for the next segment
+  without waiting for its tokens). Only eos is device-data-dependent:
+  an eos'd row burns at most the one segment that was already in
+  flight when the host learns of it, and those ticks are trimmed at
+  harvest — served tokens are IDENTICAL to the unoverlapped schedule,
+  admission simply lags one segment behind a row's (eos) completion.
 
-The horizon is per request: a row admitted with budget ``max_new``
-ticks at most ``ceil(max_new / segment) * segment`` times before it is
-harvested and freed, so admission requires ``prompt_buf +
-ceil(max_new/segment)*segment <= t_max``. A request that can NEVER
-satisfy that bound is not admitted; ``serve`` completes everything
-else and then raises :class:`HorizonError` CARRYING the completed
-outputs (``.outputs``) instead of discarding finished work.
+**Admission fairness (the documented contract).** ``admit_policy=
+"fifo"`` (default): requests are admitted strictly in arrival order —
+a free row always takes the QUEUE HEAD, and no request is ever
+leapfrogged by a later one. Because every row offers the same horizon
+(per-row positions admit at the same window offset every time), a
+request whose segment-rounded budget can never fit (``prompt_buf +
+ceil(max_new/segment)*segment > t_max``) would block the head FOREVER,
+so infeasibility is resolved up front: such requests are set aside,
+everything else is served to completion, then :class:`HorizonError` is
+raised CARRYING the completed outputs (``.outputs``) instead of
+discarding finished work. ``admit_policy="skip_fit"`` opts out of the
+head-of-line guarantee: each free row takes the FIRST queued request
+whose rounded need fits it (today that predicate is row-independent,
+so the two policies admit identical streams; skip_fit is the hook for
+deployments whose rows expose heterogeneous free horizons, and it
+handles never-fitting requests by skipping them in place rather than
+gating up front — same terminal ``HorizonError``).
 
-Correctness contract (``tests/test_serve.py``): greedy-served outputs of
-staggered admissions equal each prompt's standalone ``infer.generate``,
-token for token, for GPT-2 (learned positions), Llama (RoPE/GQA) and the
-MoE family (inference routing). MoE capacity: although admission
-prefills one row over the fixed ``prompt_buf`` window, the expert queue
-capacity is derived from the REAL prompt length (``moe_capacity``,
-static per admission — ``MoEBlock.prefill_capacity``), and pad tokens
-claim no queue slot, so the prefilled prompt tokens route with exactly
-the queues a standalone global-group prefill gives them even when
-capacity binds (ADVICE r5's serve-vs-standalone capacity divergence,
-closed). The remaining documented no-drop contract is only the LAST
+**Sampling.** Each request carries its own ``temperature`` (0 =
+greedy), ``top_k``, ``top_p`` and ``seed``; the compiled segment
+samples every row from its own settings and its own counter-based key
+stream (``infer.sample_rows``; keys are pre-split per segment outside
+the scan, the same discipline as ``infer.py`` — an in-scan split chain
+costs more than the tick's math). The key for a row's t-th token
+depends only on (seed, tokens-so-far), so sampled outputs are
+deterministic AND invariant to ``slots``/``segment`` scheduling; a
+greedy request served next to sampling requests keeps standalone
+parity (``tests/test_serve.py``).
+
+Correctness contract (``tests/test_serve.py``,
+``tests/test_serve_mesh.py``): greedy-served outputs of staggered
+admissions equal each prompt's standalone ``infer.generate``, token
+for token, for GPT-2 (learned positions), Llama (RoPE/GQA) and the
+MoE family (inference routing) — off-mesh and under data/tensor/
+expert-sharded meshes (sharded serving compares against sharded
+standalone generation: cross-LAYOUT equality is only a logits-
+tolerance property, see ``tests/test_generate.py``). MoE capacity:
+although an admission wave prefills rows over the fixed ``prompt_buf``
+window, each row is its OWN routing group whose expert queue capacity
+derives from that row's REAL prompt length (``moe_capacity_rows`` —
+``MoEBlock.prefill_capacity``/``MoELayer.apply``), and pad tokens
+claim no queue slot, so every prefilled prompt routes with exactly the
+queues a standalone global-group prefill gives it even when capacity
+binds. The remaining documented no-drop contract is only the LAST
 prompt token: serve defers it to the first decode tick, which is
 full-capacity by construction, while the standalone prefill routes it
-with capacity ``C`` — the paths can disagree only if the standalone run
-capacity-drops that one token (and, for ``top_k=2``, via its slot-2
-queue priorities; ``tests/test_serve.py`` pins both the binding-capacity
-parity and this boundary).
+with capacity ``C`` — the paths can disagree only if the standalone
+run capacity-drops that one token (``tests/test_serve.py`` pins both
+the binding-capacity parity and this boundary).
+
+Instrumentation (the transport counters ``make bench-smoke`` asserts):
+``stats`` counts segments, fetches (exactly one per segment),
+overlapped fetches (the next segment was already dispatched when the
+fetch was issued) and prefill calls/rows (one call per admission
+wave); ``waste`` attributes every non-useful row-tick to post-eos/
+budget tail, admission lag, or final drain (the serve bench's
+``waste_breakdown``).
 """
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import warnings
 from dataclasses import dataclass, field
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_compute_pytorch_tpu.core.mesh import (
+    constrain, named_sharding, use_mesh)
+from distributed_compute_pytorch_tpu.infer import (
+    _CACHE_SPEC, _constrain_cache, sample_rows)
 
 
 @dataclass
 class Request:
     """One generation request: ``tokens`` (prompt ids) in, up to
-    ``max_new`` greedy continuations out (fewer if ``eos_id`` fires)."""
+    ``max_new`` continuations out (fewer if ``eos_id`` fires).
+
+    ``temperature`` 0 (default) decodes greedily; > 0 samples, with
+    optional ``top_k``/``top_p`` truncation (both require temperature
+    > 0, mirroring ``infer.generate``). ``seed`` fixes the request's
+    sampling stream; ``None`` defaults to the request's index in the
+    ``serve()`` call, so a whole call is deterministic by default."""
 
     tokens: list
     max_new: int
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    seed: int | None = None
 
 
 @dataclass
@@ -124,8 +188,12 @@ class ContinuousBatcher:
 
     Args:
       model: any ``infer.py``-contract model (GPT-2 / Llama / MoE).
-      params: its (possibly quantized) parameters.
-      slots: cache rows decoding concurrently (the static batch).
+      params: its (possibly quantized) parameters — already committed
+        to the mesh layout when ``mesh`` is given (restore with
+        ``parallel.api.shard_pytree`` under the training strategy).
+      slots: cache rows decoding concurrently (the static batch). Under
+        a mesh it must divide over the batch axes
+        (``data * fsdp | slots``) so every device owns whole rows.
       t_max: cache length == each ROW's length bound: one request needs
         ``prompt_buf + ceil(max_new/segment)*segment <= t_max``. Rounded
         up to the Pallas cache-window multiple (8 for bf16/f32 caches,
@@ -139,35 +207,72 @@ class ContinuousBatcher:
         rejected (size it to the workload's longest prompt).
       segment: ticks per compiled decode call. Smaller = finer admission
         granularity (less tail waste when a row finishes mid-segment)
-        but more host round-trips; throughput is flat in this knob
-        because the compiled per-tick cost dominates.
+        but more host round-trips; the serve bench's ``segment_sweep``
+        and ``waste_breakdown`` (bench.py ``serve_long_stream``) carry
+        the measured trade-off for the headline workload.
       eos_id: optional stop token (rows stop early and free their slot).
+      mesh: optional ``jax.sharding.Mesh`` — SHARDED serving (module
+        docstring). Batch axes shard the cache rows, ``tensor`` the KV
+        heads (must divide ``num_kv_heads``), ``expert`` the expert
+        FFNs; ``seq`` is rejected (decode has no sequence to shard).
+      admit_policy: ``"fifo"`` (strict arrival order — the fairness
+        contract in the module docstring) or ``"skip_fit"``.
     """
 
     def __init__(self, model, params, *, slots: int, t_max: int,
                  prompt_buf: int, segment: int = 16,
-                 eos_id: int | None = None):
+                 eos_id: int | None = None, mesh=None,
+                 admit_policy: str = "fifo"):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
             raise ValueError(f"prompt_buf {prompt_buf} > t_max {t_max}")
+        if admit_policy not in ("fifo", "skip_fit"):
+            raise ValueError(f"admit_policy must be 'fifo' or 'skip_fit', "
+                             f"got {admit_policy!r}")
         self.model = model
         self.params = params
         self.B = slots
         self.Tb = prompt_buf
         self.S = segment
         self.eos_id = eos_id
+        self.admit_policy = admit_policy
+        self._mesh = mesh
         self._block = model._block()
         # does the block rope internally (needs absolute-slot positions
         # at admission)? Llama does; GPT-2/MoE embed positions instead.
-        self._block_takes_positions = "positions" in inspect.signature(
-            self._block.apply).parameters
+        sig = inspect.signature(self._block.apply).parameters
+        self._block_takes_positions = "positions" in sig
         # MoE admission capacity (ADVICE r5): blocks whose prefill routing
         # accepts an explicit capacity get it derived from the REAL prompt
-        # length, not the padded window (see _admit_impl)
-        self._block_takes_moe_capacity = "moe_capacity" in inspect.signature(
-            self._block.apply).parameters
+        # length, not the padded window (see _admit_impl); the per-row
+        # form carries each wave row's own capacity
+        self._block_takes_moe_capacity = "moe_capacity" in sig
+        self._block_takes_moe_capacity_rows = "moe_capacity_rows" in sig
         hk, hd = model.kv_cache_spec()
+        if mesh is not None:
+            shape = dict(mesh.shape)
+            tp = shape.get("tensor", 1)
+            if tp > 1 and hk % tp:
+                # GQA shards the NARROW cache: an indivisible kv-head dim
+                # would make XLA pad-and-replicate it, silently defeating
+                # the layout (same check as infer.make_generate_fn)
+                raise ValueError(
+                    f"tensor axis ({tp}) must divide num_kv_heads ({hk}) "
+                    f"for sharded serving — the KV cache shards on kv "
+                    f"heads")
+            if shape.get("seq", 1) > 1:
+                raise ValueError("serving does not compose with a seq>1 "
+                                 "mesh axis; fold those devices into data")
+            dp = shape.get("data", 1) * shape.get("fsdp", 1)
+            if slots % dp:
+                raise ValueError(
+                    f"slots ({slots}) must divide over the batch axes "
+                    f"(data*fsdp = {dp}) so every device owns whole "
+                    f"cache rows")
+            self._dp = dp
+        else:
+            self._dp = 1
         n_layers = int(jax.tree_util.tree_leaves(
             params["blocks"])[0].shape[0])
         # cache rows in the activations' dtype == the first floating
@@ -184,37 +289,71 @@ class ContinuousBatcher:
         # slot write is one window DMA per row per layer
         # (ops/pallas/cache_update.py::kv_insert_rows_pallas)
         self._n_layers = n_layers
-        self._caches = [{"kv": jnp.zeros((2, slots, hk, self.t_max, hd),
-                                         dtype)}
-                        for _ in range(n_layers)]
+
+        def dev(x, spec):
+            if mesh is None:
+                return x
+            return jax.device_put(x, named_sharding(mesh, spec))
+
+        self._caches = [
+            {"kv": dev(jnp.zeros((2, slots, hk, self.t_max, hd), dtype),
+                       _CACHE_SPEC)}
+            for _ in range(n_layers)]
         if (jax.default_backend() == "tpu"
-                and not _pallas_ok(self._caches[0], axis=3)):
+                and (mesh is not None
+                     or not _pallas_ok(self._caches[0], axis=3))):
             warnings.warn(
                 "serving caches fall off the Pallas window-write fast "
                 "path (mesh active, multi-device, or a non-window-"
                 "aligned shape): every decode tick will pay the full-"
                 "cache-copy dynamic_update_slice (~3x slower measured)",
                 stacklevel=2)
-        self._slot_mask = jnp.zeros((slots, self.t_max), jnp.float32)
-        self._cur_tok = jnp.zeros((slots,), jnp.int32)
-        self._n_logical = jnp.zeros((slots,), jnp.int32)
+        row_spec = P(("data", "fsdp"))
+        self._slot_mask = dev(jnp.zeros((slots, self.t_max), jnp.float32),
+                              P(("data", "fsdp"), None))
+        self._cur_tok = dev(jnp.zeros((slots,), jnp.int32), row_spec)
+        self._n_logical = dev(jnp.zeros((slots,), jnp.int32), row_spec)
         # per-row slot of the last written token (host-tracked: admission
         # rewinds a row to Tb-1, each segment advances every row by S)
         self._row_pos = [prompt_buf - 1] * slots
+        # per-row sampling settings (host-tracked, set at admission,
+        # shipped with every segment dispatch — no fetch)
+        self._temp = np.zeros((slots,), np.float32)
+        self._topk = np.zeros((slots,), np.int32)       # 0 = off
+        self._topp = np.full((slots,), 2.0, np.float32)  # >= 1 = off
+        self._seed = np.zeros((slots,), np.uint32)
         self.ticks = 0             # decode ticks run this session
+        self._zero_stats()
         # moe_capacity is STATIC: capacity shapes the routing one-hots, so
-        # each distinct capacity value compiles its own admission program
-        # (bounded by ceil(ecf * top_k * prompt_buf / E) values — the same
-        # per-shape compilation the standalone prefill always paid)
+        # each distinct (wave size, wave-max capacity) pair compiles its
+        # own admission program (bounded by slots x the same per-shape
+        # compilation the standalone prefill always paid); per-row
+        # capacities ride along as a traced [K] vector
         self._admit_c = jax.jit(self._admit_impl, donate_argnums=(1, 2),
                                 static_argnames=("moe_capacity",))
-        self._segment_c = jax.jit(self._segment_impl,
-                                  donate_argnums=(1,))
+        self._segment_c = jax.jit(self._segment_impl, donate_argnums=(1,),
+                                  static_argnames=("sampling",))
+
+    def _zero_stats(self):
+        # transport counters (module docstring; asserted by the CPU
+        # bench smoke): fetches == segments, every fetch with live rows
+        # behind it issued AFTER the next segment's dispatch
+        self.stats = {"segments": 0, "fetches": 0, "fetches_overlapped": 0,
+                      "prefill_calls": 0, "prefill_rows": 0}
+        # row-tick attribution for the bench's waste_breakdown: useful
+        # tokens = planned_ticks - tail (tail = post-eos + budget
+        # rounding); parked ticks split by whether work was waiting
+        self.waste = {"planned_ticks": 0, "parked_admission_lag": 0,
+                      "parked_drain": 0}
+
+    def _mesh_ctx(self):
+        return (use_mesh(self._mesh) if self._mesh is not None
+                else contextlib.nullcontext())
 
     def reset(self):
         """Fresh session on the SAME compiled programs: zero the caches,
-        masks and counters and rewind every row. Lets a caller (the
-        serve bench; a long-running server) run many sessions while
+        masks, counters and stats and rewind every row. Lets a caller
+        (the serve bench; a long-running server) run many sessions while
         paying trace+compile once — the jitted pieces are per-instance
         closures, so a new ContinuousBatcher would recompile. (With
         per-row positions rows recycle in place, so this is hygiene
@@ -224,19 +363,24 @@ class ContinuousBatcher:
         self._cur_tok = jnp.zeros_like(self._cur_tok)
         self._n_logical = jnp.zeros_like(self._n_logical)
         self._row_pos = [self.Tb - 1] * self.B
+        self._temp[:] = 0.0
+        self._topk[:] = 0
+        self._topp[:] = 2.0
+        self._seed[:] = 0
         self.ticks = 0
+        self._zero_stats()
 
     # ---- compiled pieces -------------------------------------------------
 
-    def _admit_impl(self, params, caches, slot_mask, row, prompt, pmask,
-                    moe_capacity=None):
-        """Prefill ONE request's tokens-but-the-last into cache row
-        ``row`` at the row's own window ``[0, prompt_buf)`` (left-padded:
-        an n-token head occupies slots ``prompt_buf - n ..
-        prompt_buf - 1``, so the last prefilled token always sits at
-        slot ``prompt_buf - 1``).
+    def _admit_impl(self, params, caches, slot_mask, rows, prompt, pmask,
+                    moe_capacity=None, moe_capacity_rows=None):
+        """Prefill an admission WAVE: ``K`` requests' tokens-but-the-last
+        (``prompt``/``pmask`` ``[K, prompt_buf]``, left-padded: an
+        n-token head occupies slots ``prompt_buf - n .. prompt_buf - 1``)
+        into cache rows ``rows [K]``, each at the row's own window
+        ``[0, prompt_buf)`` — ONE compiled forward for the whole wave.
 
-        The request's LAST prompt token is deliberately NOT prefilled:
+        Each request's LAST prompt token is deliberately NOT prefilled:
         the host sets it as the row's current token and the next
         segment's first tick consumes it — writing its K/V at slot
         ``prompt_buf`` and sampling the request's first new token
@@ -247,12 +391,26 @@ class ContinuousBatcher:
         the per-segment token harvest). The window offset is STATIC
         (always 0): per-row positions removed the old
         global-position-dependent offset entirely.
+
+        Under a mesh, the wave's K/V (``[2, K, hk, Tb, hd]``, kv heads
+        pinned over ``tensor``) is scattered into the ROW-sharded cache
+        — the layout change IS the scatter's resharding collective, the
+        portable-redistribution move the module docstring names. The
+        host pads ``K`` up to a multiple of the batch-axes product
+        (pad rows carry all-zero masks and an OUT-OF-BOUNDS row index;
+        ``mode="drop"`` discards their writes): an UNEVENLY
+        batch-sharded prefill was observed to miscompile under
+        mixed-axes meshes on this backend (wrong K/V values for a
+        1-row wave on data x expert, CPU SPMD — the same partitioner
+        fragility ``core.mesh.constrain_activations`` documents), and
+        even partitioning keeps it on the well-trodden path.
         """
         model, Tb = self.model, self.Tb
         pad_count = Tb - jnp.sum(pmask.astype(jnp.int32), axis=1)
         logical = jnp.maximum(jnp.arange(Tb)[None, :] - pad_count[:, None],
                               0)
-        x = model.embed(params, prompt, logical)
+        x = constrain(model.embed(params, prompt, logical),
+                      P(("data", "fsdp"), None, None))
         blocks = params["blocks"]
         kvs = []
         for i in range(self._n_layers):
@@ -262,54 +420,83 @@ class ContinuousBatcher:
             if self._block_takes_positions:
                 kw["positions"] = jnp.arange(Tb)   # absolute slots 0..Tb-1
             if self._block_takes_moe_capacity and moe_capacity is not None:
-                # expert queues sized for the REAL token count: pads route
-                # nowhere (kv_mask), so the real tokens see exactly the
-                # standalone prefill's capacity instead of the window's
+                # expert queues sized for each row's REAL token count:
+                # pads route nowhere (kv_mask) and every row is its own
+                # routing group (models/moe.py), so the real tokens see
+                # exactly the standalone prefill's capacity instead of
+                # the window's
                 kw["moe_capacity"] = moe_capacity
+                if (self._block_takes_moe_capacity_rows
+                        and moe_capacity_rows is not None):
+                    kw["moe_capacity_rows"] = moe_capacity_rows
             x = self._block.apply(p_i, x, **kw)
             if isinstance(x, tuple):   # MoE blocks return (x, aux)
                 x = x[0]
-            (k, v), = sink             # [1, hk, Tb, hd]
+            (k, v), = sink             # [K, hk, Tb, hd]
             kvs.append((k, v))
-        caches = [
-            {"kv": lax.dynamic_update_slice(
-                c["kv"],
-                jnp.stack([k, v]).astype(c["kv"].dtype),  # [2,1,hk,Tb,hd]
-                (0, row, 0, 0, 0))}
-            for c, (k, v) in zip(caches, kvs)]
-        # row's slot validity: the prompt mask inside the window, open
-        # for decode after it — overwriting whatever the row's previous
-        # occupant left (slots beyond the live position are additionally
-        # hidden by the per-row position mask)
-        m = jnp.concatenate([pmask[0].astype(jnp.float32),
-                             jnp.ones((self.t_max - Tb,), jnp.float32)])
-        slot_mask = lax.dynamic_update_slice(slot_mask, m[None], (row, 0))
-        return caches, slot_mask
+        new_caches = []
+        for c, (k, v) in zip(caches, kvs):
+            kv = constrain(jnp.stack([k, v]).astype(c["kv"].dtype),
+                           P(None, None, "tensor", None, None))
+            new_caches.append(
+                {"kv": c["kv"].at[:, rows, :, :Tb, :].set(kv,
+                                                          mode="drop")})
+        # each row's slot validity: the prompt mask inside the window,
+        # open for decode after it — overwriting whatever the row's
+        # previous occupant left (slots beyond the live position are
+        # additionally hidden by the per-row position mask)
+        m = jnp.concatenate(
+            [pmask.astype(jnp.float32),
+             jnp.ones((pmask.shape[0], self.t_max - Tb), jnp.float32)],
+            axis=1)
+        slot_mask = slot_mask.at[rows].set(m, mode="drop")
+        return new_caches, slot_mask
 
     def _segment_impl(self, params, caches, slot_mask, tok, n_logical,
-                      positions0):
+                      positions0, temp, top_k, top_p, seeds,
+                      sampling: bool = False):
         """``S`` decode ticks for every row at its OWN position
         (``positions0 [B]`` = each row's last written slot); returns the
-        [B, S] greedy tokens and the carried state."""
+        [B, S] next tokens and the carried state. ``sampling`` (static)
+        compiles the per-row sampling path (``infer.sample_rows``) in;
+        greedy-only sessions keep the bare argmax program. Per-tick keys
+        are PRE-SPLIT outside the scan (one vectorised threefry per
+        segment — the in-scan split chain costs more than the tick's
+        math, ``infer.py``), keyed on (row seed, tokens-so-far) so
+        sampled streams are scheduling-invariant."""
         model = self.model
         blocks = params["blocks"]
+        if sampling:
+            base = jax.vmap(jax.random.key)(seeds)
+            keys = jax.vmap(lambda k, n0: jax.vmap(
+                lambda i: jax.random.fold_in(k, n0 + i))(
+                    jnp.arange(self.S)))(base, n_logical)     # [B, S]
+            tick_keys = jnp.swapaxes(keys, 0, 1)              # scan xs
+        else:
+            tick_keys = jnp.zeros((self.S,), jnp.uint32)      # unused xs
 
-        def tick(carry, i):
+        def tick(carry, xs):
+            i, key = xs
             tok, caches, n_log = carry
             p = positions0 + 1 + i         # [B] per-row slot being written
-            x = model.embed(params, tok[:, None], n_log[:, None])
+            x = constrain(model.embed(params, tok[:, None], n_log[:, None]),
+                          P(("data", "fsdp"), None, None))
             new_caches = []
             for li in range(self._n_layers):
                 p_l = jax.tree.map(lambda a: a[li], blocks)
                 x, c2 = self._block.decode_step(p_l, x, caches[li], p,
                                                 slot_mask=slot_mask)
-                new_caches.append(c2)
-            nxt = jnp.argmax(model.readout(params, x)[:, -1],
-                             axis=-1).astype(jnp.int32)
+                new_caches.append(_constrain_cache(c2))
+            logits = model.readout(params, x)[:, -1]
+            if sampling:
+                nxt = sample_rows(logits, temp, top_k, top_p, key)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (nxt, new_caches, n_log + 1), nxt
 
         (tok, caches, n_logical), toks = lax.scan(
-            tick, (tok, caches, n_logical), jnp.arange(self.S))
+            tick, (tok, caches, n_logical),
+            (jnp.arange(self.S), tick_keys))
         return caches, tok, n_logical, toks.transpose(1, 0)
 
     # ---- host scheduler --------------------------------------------------
@@ -321,15 +508,10 @@ class ContinuousBatcher:
         worst-case tick count)."""
         return -(-max_new // self.S) * self.S
 
-    def serve(self, requests: list[Request]) -> list[list[int]]:
-        """Run every request through the pool; returns each request's
-        generated tokens (trimmed at eos), in request order.
+    def _fits(self, req: Request) -> bool:
+        return self.Tb + self._rounded_need(req.max_new) <= self.t_max
 
-        Requests whose segment-rounded budget can never fit a row
-        (``prompt_buf + ceil(max_new/segment)*segment > t_max``) are
-        rejected: everything else is served to completion FIRST, then
-        :class:`HorizonError` is raised with ``.outputs`` carrying the
-        completed results."""
+    def _validate(self, requests):
         for r in requests:
             if len(r.tokens) > self.Tb:
                 raise ValueError(
@@ -339,77 +521,212 @@ class ContinuousBatcher:
                 raise ValueError("empty prompt")
             if r.max_new < 1:
                 raise ValueError(f"max_new must be >= 1, got {r.max_new}")
+            if r.temperature < 0.0:
+                raise ValueError(
+                    f"temperature must be >= 0, got {r.temperature}")
+            if r.temperature == 0.0 and (r.top_k is not None
+                                         or r.top_p is not None):
+                raise ValueError("top_k/top_p require temperature > 0 "
+                                 "(temperature 0 is greedy)")
+            if r.top_k is not None and r.top_k < 1:
+                raise ValueError(f"top_k must be >= 1, got {r.top_k}")
+            if r.top_p is not None and not 0.0 < r.top_p <= 1.0:
+                raise ValueError(f"top_p must be in (0, 1], got {r.top_p}")
+
+    def serve(self, requests: list[Request]) -> list[list[int]]:
+        """Run every request through the pool; returns each request's
+        generated tokens (trimmed at eos), in request order.
+
+        Requests whose segment-rounded budget can never fit a row
+        (``prompt_buf + ceil(max_new/segment)*segment > t_max``) are
+        rejected: everything else is served to completion FIRST, then
+        :class:`HorizonError` is raised with ``.outputs`` carrying the
+        completed results. Admission order follows ``admit_policy``
+        (class docstring: strict-FIFO fairness by default)."""
+        self._validate(requests)
         outputs: list[list[int] | None] = [None] * len(requests)
-        # per-request horizon gate (segment-rounded): a reject here is
-        # PERMANENT — per-row positions admit at the same window offset
-        # every time, so what can't fit now can never fit
-        rejected = [i for i, r in enumerate(requests)
-                    if self.Tb + self._rounded_need(r.max_new) > self.t_max]
-        rejected_set = set(rejected)
-        queue = [i for i in range(len(requests)) if i not in rejected_set]
+        sampling = any(r.temperature > 0.0 for r in requests)
+        if self.admit_policy == "fifo":
+            # per-request horizon gate (segment-rounded): a reject here
+            # is PERMANENT — per-row positions admit at the same window
+            # offset every time, so what can't fit now can never fit,
+            # and FIFO refuses to leapfrog, so an infeasible head would
+            # block the queue forever
+            rejected = [i for i, r in enumerate(requests)
+                        if not self._fits(r)]
+            rejected_set = set(rejected)
+            queue = [i for i in range(len(requests))
+                     if i not in rejected_set]
+        else:
+            # skip_fit: never-fitting requests are skipped in place at
+            # admission time and reported at the end
+            queue = list(range(len(requests)))
         table = [_Slot() for _ in range(self.B)]
 
-        def admit_next():
-            for b, slot in enumerate(table):
-                if slot.req_index >= 0 or not queue:
-                    continue
-                ri = queue.pop(0)
+        def pick_admissions(k_free: int) -> list[int]:
+            take: list[int] = []
+            if self.admit_policy == "fifo":
+                while queue and len(take) < k_free:
+                    take.append(queue.pop(0))
+            else:
+                i = 0
+                while i < len(queue) and len(take) < k_free:
+                    if self._fits(requests[queue[i]]):
+                        take.append(queue.pop(i))
+                    else:
+                        i += 1
+            return take
+
+        def admit_wave():
+            """ONE multi-row prefill for every pending request that has
+            a free row (the batched admission: k admissions, 1 dispatch).
+            All host->device, no fetch."""
+            free = [b for b, s in enumerate(table) if s.req_index < 0]
+            take = pick_admissions(len(free))
+            if not take:
+                return
+            K = len(take)
+            rows = free[:K]
+            # pad the wave to a multiple of the batch-axes product: pad
+            # rows are all-masked and scatter OUT OF BOUNDS (dropped) —
+            # see _admit_impl's partitioner note; off-mesh _dp == 1
+            Kp = -(-K // self._dp) * self._dp
+            prompt = np.zeros((Kp, self.Tb), np.int32)
+            pmask = np.zeros((Kp, self.Tb), np.float32)
+            lasts = np.zeros((K,), np.int32)
+            n_log = np.zeros((K,), np.int32)
+            caps = []
+            for j, ri in enumerate(take):
                 req = requests[ri]
                 # prefill all but the last prompt token; the next
-                # segment's first tick consumes that one (see
-                # _admit_impl) — all host->device, no fetch
-                head, last = req.tokens[:-1], req.tokens[-1]
+                # segment's first tick consumes that one (_admit_impl)
+                head, lasts[j] = req.tokens[:-1], req.tokens[-1]
                 n = len(head)
-                prompt = np.zeros((1, self.Tb), np.int32)
-                pmask = np.zeros((1, self.Tb), np.float32)
+                n_log[j] = n
                 if n:
-                    prompt[0, self.Tb - n:] = head
-                    pmask[0, self.Tb - n:] = 1.0
-                cap = (self._block.prefill_capacity(len(req.tokens))
-                       if self._block_takes_moe_capacity else None)
+                    prompt[j, self.Tb - n:] = head
+                    pmask[j, self.Tb - n:] = 1.0
+                if self._block_takes_moe_capacity:
+                    caps.append(self._block.prefill_capacity(
+                        len(req.tokens)))
+            kw = {}
+            if caps:
+                kw["moe_capacity"] = max(caps)
+                if self._block_takes_moe_capacity_rows:
+                    kw["moe_capacity_rows"] = jnp.asarray(
+                        caps + [1] * (Kp - K), jnp.int32)
+            rows_j = jnp.asarray(rows, jnp.int32)
+            rows_pad = jnp.asarray(rows + [self.B] * (Kp - K), jnp.int32)
+            with self._mesh_ctx():
                 self._caches, self._slot_mask = self._admit_c(
-                    self.params, self._caches, self._slot_mask,
-                    jnp.int32(b), jnp.asarray(prompt), jnp.asarray(pmask),
-                    moe_capacity=cap)
-                self._cur_tok = self._cur_tok.at[b].set(last)
-                self._n_logical = self._n_logical.at[b].set(n)
+                    self.params, self._caches, self._slot_mask, rows_pad,
+                    jnp.asarray(prompt), jnp.asarray(pmask), **kw)
+                self._cur_tok = self._cur_tok.at[rows_j].set(
+                    jnp.asarray(lasts))
+                self._n_logical = self._n_logical.at[rows_j].set(
+                    jnp.asarray(n_log))
+            for j, ri in enumerate(take):
+                b = rows[j]
+                req = requests[ri]
                 self._row_pos[b] = self.Tb - 1   # the row's own horizon
+                self._temp[b] = req.temperature
+                self._topk[b] = req.top_k or 0
+                self._topp[b] = req.top_p if req.top_p is not None else 2.0
+                self._seed[b] = np.uint32(
+                    req.seed if req.seed is not None else ri)
+                slot = table[b]
                 slot.req_index = ri
                 slot.out = []
                 slot.remaining = req.max_new
-            return
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_rows"] += K
 
-        def any_active():
-            return any(s.req_index >= 0 for s in table)
-
-        while queue or any_active():
-            admit_next()
-            if not any_active():
-                break
-            # park free rows at the window edge: they still tick (the
-            # compiled segment is all-rows), and rewinding keeps their
-            # garbage writes inside [Tb, Tb + S) — in range because any
-            # active admission implies Tb + S <= t_max
+        def dispatch_segment():
+            """Dispatch ONE compiled segment (no fetch). Returns the
+            (device tokens, plan) pair the later harvest consumes, or
+            None when no row has budget left to tick. Budget depletion
+            is applied HERE, at dispatch — it is host-known — so the
+            overlapping caller can decide about segment N+1 without
+            waiting for segment N's tokens; rows that are done (or
+            free) are parked at the window edge, where their garbage
+            writes stay inside [Tb, Tb + S) (in range because any
+            admission implies Tb + S <= t_max)."""
+            plan = []
             for b, slot in enumerate(table):
-                if slot.req_index < 0:
+                if slot.req_index >= 0 and slot.remaining > 0:
+                    take = min(slot.remaining, self.S)
+                    plan.append((b, slot.req_index, take,
+                                 slot.remaining - take <= 0))
+            if not plan:
+                return None
+            pending = (bool(queue) if self.admit_policy == "fifo"
+                       else any(self._fits(requests[i]) for i in queue))
+            active = {b for b, _, _, _ in plan}
+            for b in range(self.B):
+                if b not in active:
                     self._row_pos[b] = self.Tb - 1
-            (self._caches, self._cur_tok, self._n_logical, toks
-             ) = self._segment_c(self.params, self._caches,
-                                 self._slot_mask, self._cur_tok,
-                                 self._n_logical,
-                                 jnp.asarray(self._row_pos, jnp.int32))
+                    key = ("parked_admission_lag" if pending
+                           else "parked_drain")
+                    self.waste[key] += self.S
+            with self._mesh_ctx():
+                (self._caches, self._cur_tok, self._n_logical, toks
+                 ) = self._segment_c(
+                    self.params, self._caches, self._slot_mask,
+                    self._cur_tok, self._n_logical,
+                    jnp.asarray(self._row_pos, jnp.int32),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._seed),
+                    sampling=sampling)
             for b in range(self.B):
                 self._row_pos[b] += self.S
             self.ticks += self.S
+            self.stats["segments"] += 1
+            for b, ri, take, _ in plan:
+                table[b].remaining -= take
+                self.waste["planned_ticks"] += self.S
+            return toks, plan
+
+        def harvest(seg, overlapped: bool):
+            """THE one device->host fetch per segment. ``overlapped``
+            records whether the next segment was already dispatched
+            (the counter the bench smoke asserts)."""
+            toks, plan = seg
+            self.stats["fetches"] += 1
+            if overlapped:
+                self.stats["fetches_overlapped"] += 1
             toks_h = np.asarray(toks)
-            for b, slot in enumerate(table):
-                if slot.req_index < 0:
+            for b, ri, take, done_after in plan:
+                if outputs[ri] is not None:
+                    # the request finished (eos) in an earlier segment
+                    # while this one was already in flight — its ticks
+                    # are overlap tail waste, never tokens
                     continue
-                take = min(slot.remaining, self.S)
+                slot = table[b]
                 slot.out.extend(int(t) for t in toks_h[b, :take])
-                slot.remaining -= take
-                self._finish_if_done(slot, outputs)
+                done = done_after
+                if self.eos_id is not None and self.eos_id in slot.out:
+                    slot.out = slot.out[:slot.out.index(self.eos_id) + 1]
+                    done = True
+                if done:
+                    outputs[ri] = slot.out
+                    slot.req_index = -1
+                    slot.out = []
+                    slot.remaining = 0
+
+        # ---- the overlapped loop: dispatch N+1 BEFORE fetching N ----
+        admit_wave()
+        seg = dispatch_segment()
+        while seg is not None:
+            nxt = dispatch_segment()       # overlap (None: nothing live)
+            harvest(seg, overlapped=nxt is not None)
+            admit_wave()                   # freed rows -> wave for N+2
+            if nxt is None:
+                nxt = dispatch_segment()   # revived by fresh admissions
+            seg = nxt
+
         results = [o if o is not None else [] for o in outputs]
+        if self.admit_policy != "fifo":
+            rejected = [i for i in queue if outputs[i] is None]
         if rejected:
             worst = max(self._rounded_need(requests[i].max_new)
                         for i in rejected)
@@ -420,16 +737,3 @@ class ContinuousBatcher:
                 f"raise t_max or shrink max_new (completed outputs are "
                 f"on this error's .outputs)", results)
         return results
-
-    def _finish_if_done(self, slot: _Slot, outputs):
-        if slot.req_index < 0:
-            return
-        done = slot.remaining <= 0
-        if self.eos_id is not None and self.eos_id in slot.out:
-            slot.out = slot.out[:slot.out.index(self.eos_id) + 1]
-            done = True
-        if done:
-            outputs[slot.req_index] = slot.out
-            slot.req_index = -1
-            slot.out = []
-            slot.remaining = 0
