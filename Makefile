@@ -3,6 +3,24 @@
 # CI, and a laptop all run the identical suite (CPU backend, slow tests
 # excluded, collection errors tolerated so one broken module can't hide
 # the rest of the signal).
+#
+# What `-m 'not slow'` excludes (the container's 870s tier-1 timeout
+# otherwise truncates the suite tail — PR 2 note):
+# 1. subprocess/e2e tests that pay a fresh XLA compile per process
+#    (test_elastic supervisor drills);
+# 2. heavy REDUNDANT mesh parametrizations whose siblings keep the
+#    coverage in tier-1 (test_generate fsdp=8 — the 3-axis case shards
+#    fsdp too; test_serve long-stream MoE — family-independent host
+#    logic pinned by gpt2/llama, MoE exactness has its own tests);
+# 3. the CONTAINER-BACKEND-GAP set (see `_container_backend_gap` in
+#    test_pipeline/test_ladder_models/test_llama/test_moe/test_remat/
+#    test_trainer_strategy): composed-mesh and remat parity cases that
+#    cannot pass on this container's legacy shard_map backend
+#    (PartitionId-under-SPMD + old-jax version gaps, the PR 1/PR 2
+#    known-failure set) and burned ~6 min of budget producing no
+#    signal. They run in `make test` and on hardware dryruns.
+# Nothing marked slow is the only in-budget test of a feature that can
+# pass on this container. Run the full suite with `make test`.
 
 SHELL := /bin/bash
 
@@ -26,6 +44,11 @@ bench:
 #   counters; fails unless each segment costs exactly one device->host
 #   fetch issued AFTER the next segment's dispatch (overlap), admission
 #   waves are single multi-row prefills, and the KV cache lands sharded
+# - grad-accum: the step-level accumulation A/B (legacy MultiSteps vs
+#   boundary vs bucketed boundary); fails unless the compiled update
+#   holds ZERO grad collectives inside the microbatch scan, wire bytes
+#   per update drop N x, and one fused dispatch beats N legacy ones
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --zero1-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-smoke
+	JAX_PLATFORMS=cpu python bench.py --grad-accum-smoke

@@ -125,7 +125,19 @@ class Config:
     # --- optimizer extras (train/optim.py) ---
     weight_decay: float = 0.0      # AdamW decay (matrices only, masked)
     clip_norm: float = 0.0         # global-grad-norm clip (0 = off)
-    grad_accum: int = 1            # micro-steps accumulated per update
+    grad_accum: int = 1            # microbatches accumulated per update,
+                                   # STEP-LEVEL (train/step.py): effective
+                                   # batch N x batch_size, one gradient
+                                   # reduction + one dispatch per update
+    accum_dtype: str = "float32"   # grad-accumulator dtype (float32 |
+                                   # bfloat16 — half the accumulator HBM
+                                   # and boundary wire bytes, bounded
+                                   # rounding; tests pin the tolerance)
+    accum_bucket_mb: float = 25.0  # boundary-reduction bucket size (MB,
+                                   # DDP bucket_cap_mb analog): bucket k's
+                                   # reduce-scatter overlaps bucket k-1's
+                                   # optimizer update + gather; 0 = one
+                                   # single-shot boundary (bit-identical)
     warmup_steps: int = 0          # LR warmup updates (adamw schedule)
     # ZeRO-1 cross-replica weight-update sharding (train/step.py,
     # parallel/collectives.py): reduce-scatter grads -> shard-local
@@ -267,9 +279,28 @@ class Config:
         p.add_argument("--clip_norm", type=float, default=cls.clip_norm,
                        help="clip gradients to this global norm (0 = off)")
         p.add_argument("--grad_accum", type=int, default=cls.grad_accum,
-                       help="accumulate N micro-step gradients per "
-                            "optimizer update (N-times effective batch at "
-                            "constant activation memory)")
+                       help="accumulate N microbatch gradients per "
+                            "optimizer update INSIDE the compiled step "
+                            "(effective batch N x batch_size at "
+                            "one-microbatch activation memory; exactly "
+                            "ONE gradient reduction per update — the DDP "
+                            "no_sync analog — composing with "
+                            "shard_update, quant_collectives, remat and "
+                            "adamw_fused; step counts tick per update)")
+        p.add_argument("--accum_dtype", type=str, default=cls.accum_dtype,
+                       choices=("float32", "bfloat16", "f32", "bf16"),
+                       help="gradient-accumulator dtype under "
+                            "--grad_accum>1: bfloat16 halves the "
+                            "accumulator HBM and the boundary psum wire "
+                            "bytes at a bounded rounding cost")
+        p.add_argument("--accum_bucket_mb", type=float,
+                       default=cls.accum_bucket_mb,
+                       help="bucket size (MB) for the accumulation "
+                            "boundary's reduce->update->gather pipeline "
+                            "(DDP bucket_cap_mb analog; overlap of "
+                            "bucket k's collective with bucket k-1's "
+                            "update; 0 = single-shot boundary, "
+                            "bit-identical numerics)")
         p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps,
                        help="LR warmup updates for the adamw "
                             "warmup-cosine schedule")

@@ -291,6 +291,29 @@ def _manual_axis_names() -> tuple[set, object]:
     return manual | extra, am
 
 
+def manual_batch_axes():
+    """``(axes, world)``: the BATCH axes that are currently MANUAL (the
+    step functions run their grad-accum / quantized bodies inside a
+    shard_map manual over the dp axes) and their combined size.
+
+    Layers whose train-time math reduces over the batch dimension
+    (BatchNorm) consult this: inside such a region the batch dim is
+    shard-LOCAL, so a plain ``jnp.mean`` would compute per-replica
+    statistics — psum/pmean over the returned axes restores the global
+    (sync-BN) semantics the framework pins (``tests/test_batchnorm.py``).
+    Returns ``((), 1)`` outside manual regions, where the automatic
+    partitioner already inserts the cross-device reduction."""
+    mesh = current_mesh()
+    if mesh is None or not _manual_stack:
+        return (), 1
+    manual, _ = _manual_axis_names()
+    axes = tuple(a for a in BATCH_AXES
+                 if a in manual and a in mesh.axis_names
+                 and mesh.shape[a] > 1)
+    world = math.prod(mesh.shape[a] for a in axes) if axes else 1
+    return axes, world
+
+
 def constrain(x, spec: P):
     """Pin ``x``'s sharding when a mesh context is active (no-op off-mesh).
 

@@ -173,6 +173,14 @@ class BatchNorm:
     gradients but not BN stats (per-replica stats): global stats are what
     make DP-N numerically equal to one big-device run, which our tests pin
     (``tests/test_step.py``, ``tests/test_batchnorm.py``).
+
+    Inside a shard_map region MANUAL over the dp axes (the step-level
+    grad-accum body, ``train/step.py``) the partitioner never sees the
+    batch dim — it is shard-local — so the layer restores sync-BN itself:
+    ``core.mesh.manual_batch_axes`` names the manual batch axes and the
+    statistics pmean over them (variance via E[x²]−E[x]², the shard-
+    composable form). Outside manual regions the formula (and so the
+    numerics) is unchanged.
     """
 
     num_features: int
@@ -194,9 +202,20 @@ class BatchNorm:
     def apply(self, params, state, x, train: bool):
         reduce_axes = tuple(range(x.ndim - 1))
         if train:
-            mean = jnp.mean(x, reduce_axes)
-            var = jnp.var(x, reduce_axes)
-            n = x.size // x.shape[-1]
+            from distributed_compute_pytorch_tpu.core.mesh import (
+                manual_batch_axes)
+            axes, world = manual_batch_axes()
+            if axes:
+                # shard-local batch dim: psum the moments back to global
+                # (sync-BN) statistics; equal-size shards (the feeder's
+                # guarantee) make pmean-of-means the global mean
+                mean = lax.pmean(jnp.mean(x, reduce_axes), axes)
+                msq = lax.pmean(jnp.mean(jnp.square(x), reduce_axes), axes)
+                var = jnp.maximum(msq - jnp.square(mean), 0.0)
+            else:
+                mean = jnp.mean(x, reduce_axes)
+                var = jnp.var(x, reduce_axes)
+            n = (x.size // x.shape[-1]) * world
             unbiased = var * (n / max(n - 1, 1))
             new_state = {
                 "mean": (1 - self.momentum) * state["mean"]

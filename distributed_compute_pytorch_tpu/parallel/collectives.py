@@ -212,10 +212,24 @@ def shard_slice(x, axis_name, n: int, dim: int = 0):
     """This rank's 1/n shard of a REPLICATED local value ``x`` (inside a
     manual region): the zero-comm complement of :func:`all_gather`, used
     where params enter a region replicated but the update runs on the
-    shard."""
+    shard. ``axis_name`` may be a tuple of manual axes (multi-axis dp):
+    the combined lexicographic rank index picks the shard, matching the
+    layout ``P((a, b))`` gives the same leaf under the partitioner."""
     size = x.shape[dim] // n
-    idx = lax.axis_index(axis_name)
+    idx = axes_index(axis_name)
     return lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
+
+
+def axes_index(axis_name):
+    """Combined rank index over one manual axis or a tuple of them —
+    lexicographic (row-major) over the tuple, the same order a
+    PartitionSpec entry ``(a, b)`` lays shards out in."""
+    if isinstance(axis_name, str):
+        return lax.axis_index(axis_name)
+    idx = lax.axis_index(axis_name[0])
+    for a in axis_name[1:]:
+        idx = idx * lax.psum(1, a) + lax.axis_index(a)
+    return idx
 
 
 def spec_shard_dim(spec: P):
@@ -225,3 +239,219 @@ def spec_shard_dim(spec: P):
         if entry is not None:
             return d
     return None
+
+
+# ---------------------------------------------------------------------------
+# parameter buckets: the DDP-style reduce -> update -> gather pipeline
+# ---------------------------------------------------------------------------
+#
+# The step-level grad-accum boundary (train/step.py) reduces ONE set of
+# accumulated gradients per optimizer update. Done leaf-by-leaf in a single
+# pass, every reduce-scatter must finish before the first optimizer byte
+# moves. Bucketing (torch DDP's bucket_cap_mb, arXiv:1810.11112 §3) instead
+# groups leaves into ~fixed-byte buckets and runs reduce(k) -> update(k) ->
+# gather(k) per bucket: bucket k's collective has no data dependency on
+# bucket k-1's update, so XLA's async collectives overlap the wire time of
+# one bucket with the optimizer math of the previous one. The grouping is
+# numerically invisible — each leaf's reduction and update math is
+# identical, only the issue order changes — so bucketed == single-shot
+# bit-for-bit (tests/test_grad_accum.py pins it).
+
+# DDP's default bucket size; 0 disables bucketing (single-shot boundary)
+DEFAULT_BUCKET_MB = 25.0
+
+
+def bucketize(tree, bucket_bytes: float):
+    """Greedily group ``tree``'s leaves (flatten order) into contiguous
+    buckets of at least ``bucket_bytes`` accumulated dense size. Returns a
+    list of tuples of flat leaf indices covering every leaf exactly once;
+    ``bucket_bytes <= 0`` yields one bucket with everything."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if bucket_bytes <= 0:
+        return [tuple(range(len(leaves)))] if leaves else []
+    buckets, cur, cur_b = [], [], 0
+    for i, leaf in enumerate(leaves):
+        cur.append(i)
+        cur_b += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        if cur_b >= bucket_bytes:
+            buckets.append(tuple(cur))
+            cur, cur_b = [], 0
+    if cur:
+        buckets.append(tuple(cur))
+    return buckets
+
+
+def _mask_tree(tree, treedef, keep):
+    """``tree`` (structure ``treedef``) with every leaf whose flat index is
+    not in ``keep`` replaced by ``None`` — an EMPTY subtree to jax, so the
+    masked tree flattens to exactly the kept leaves and ``tree.map`` over
+    identically-masked trees visits only them. This is what lets an optax
+    chain update one BUCKET of leaves: paths (and so the name-keyed decay
+    mask) are preserved, out-of-bucket leaves simply do not exist."""
+    keep = set(keep)
+    leaves = treedef.flatten_up_to(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [l if i in keep else None for i, l in enumerate(leaves)])
+
+
+class OptStateBuckets:
+    """Split/merge an optimizer state along parameter buckets.
+
+    Any subtree of ``opt_state`` whose structure equals the params treedef
+    (AdamW's mu/nu, momentum traces, Adadelta accumulators) is masked per
+    bucket like the params; everything else (step counts, schedule state)
+    is SHARED into every bucket unchanged. On merge, per-bucket outputs
+    reassemble the params-shaped trees leaf-by-leaf and scalar state is
+    taken from the first bucket — every bucket computed it from the same
+    input count, so the copies are identical by construction (this is also
+    why each bucket's bias correction is consistent: all buckets read the
+    pre-update count)."""
+
+    def __init__(self, opt_state, params_treedef, buckets):
+        self.params_treedef = params_treedef
+        self.buckets = [tuple(sorted(b)) for b in buckets]
+
+        def is_params_tree(x):
+            try:
+                return jax.tree_util.tree_structure(x) == params_treedef
+            except Exception:  # noqa: BLE001 — non-pytree nodes
+                return False
+
+        self._outer, self._outer_def = jax.tree_util.tree_flatten(
+            opt_state, is_leaf=is_params_tree)
+        self._is_ptree = [is_params_tree(l) for l in self._outer]
+
+    def state_for(self, k: int):
+        """The bucket-``k`` view of the opt_state handed to ``tx.update``."""
+        keep = self.buckets[k]
+        return jax.tree_util.tree_unflatten(self._outer_def, [
+            _mask_tree(l, self.params_treedef, keep) if p else l
+            for l, p in zip(self._outer, self._is_ptree)])
+
+    def merge(self, bucket_states):
+        """Reassemble the full new opt_state from per-bucket outputs."""
+        outs = [self._outer_def.flatten_up_to(s) for s in bucket_states]
+        n_leaves = self.params_treedef.num_leaves
+        merged = []
+        for pos, is_p in enumerate(self._is_ptree):
+            if not is_p:
+                merged.append(outs[0][pos])
+                continue
+            full = [None] * n_leaves
+            for k, keep in enumerate(self.buckets):
+                got = jax.tree_util.tree_leaves(outs[k][pos])
+                for i, leaf in zip(keep, got):
+                    full[i] = leaf
+            merged.append(jax.tree_util.tree_unflatten(self.params_treedef,
+                                                       full))
+        return jax.tree_util.tree_unflatten(self._outer_def, merged)
+
+
+def bucketed_update(grads, opt_state, params, specs, buckets, *,
+                    reduce_leaf, slice_leaf, gather_leaf, update_fn):
+    """The pipelined boundary: per bucket, reduce the accumulated local
+    gradients (``reduce_leaf(g, spec, p)`` — psum, reduce-scatter, or the
+    quantized exchange), slice the replicated params to the update shard
+    (``slice_leaf``), apply the optimizer to the bucket
+    (``update_fn(g, o, p) -> (new_p, new_o)`` on masked trees), and
+    all-gather the updated shard back (``gather_leaf``). Buckets are
+    independent dataflow chains, so XLA overlaps bucket k's collective
+    with bucket k-1's update. Returns ``(new_params, new_opt_state)``
+    with the same structure/sharding as the inputs."""
+    treedef = jax.tree_util.tree_structure(params)
+    p_leaves = treedef.flatten_up_to(params)
+    g_leaves = treedef.flatten_up_to(grads)
+    s_leaves = treedef.flatten_up_to(specs)
+    state_bk = OptStateBuckets(opt_state, treedef, buckets)
+    new_p = [None] * len(p_leaves)
+    out_states = []
+    for k, keep in enumerate(state_bk.buckets):
+        g_k = {i: reduce_leaf(g_leaves[i], s_leaves[i], p_leaves[i])
+               for i in keep}
+        p_k = {i: slice_leaf(p_leaves[i], s_leaves[i]) for i in keep}
+        g_tree = jax.tree_util.tree_unflatten(
+            treedef, [g_k.get(i) for i in range(len(p_leaves))])
+        p_tree = jax.tree_util.tree_unflatten(
+            treedef, [p_k.get(i) for i in range(len(p_leaves))])
+        np_tree, no_tree = update_fn(g_tree, state_bk.state_for(k), p_tree)
+        for i, leaf in zip(keep, jax.tree_util.tree_leaves(np_tree)):
+            new_p[i] = gather_leaf(leaf, s_leaves[i])
+        out_states.append(no_tree)
+    return (jax.tree_util.tree_unflatten(treedef, new_p),
+            state_bk.merge(out_states))
+
+
+# ---------------------------------------------------------------------------
+# jaxpr collective audit — the grad-accum "one reduction per update" proof
+# ---------------------------------------------------------------------------
+
+# cross-replica reduction primitives (jaxpr names on the supported jax
+# versions). all_gather is recorded too (the ZeRO-1 param gather leg) but
+# is not a GRADIENT collective — callers filter on `prim`.
+_REDUCE_PRIMS = ("psum", "psum_scatter", "reduce_scatter", "all_to_all")
+_LOOP_PRIMS = ("scan", "while")
+
+
+def jaxpr_collectives(fn_or_jaxpr, *args, **kwargs):
+    """Walk a function's jaxpr (or an already-made ``ClosedJaxpr``) and
+    record every cross-replica collective: ``{prim, axes, bytes,
+    in_loop}`` per equation, recursing through pjit/shard_map/scan/cond
+    sub-jaxprs. ``bytes`` is the summed operand size — for a gradient
+    reduction, the bytes that cross the wire per participating chip
+    (up to the collective algorithm's constant). ``in_loop`` marks
+    equations under a ``scan``/``while`` body: a gradient collective
+    there executes once PER MICROBATCH, which is exactly what the
+    step-level accumulation boundary exists to eliminate."""
+    jx = fn_or_jaxpr
+    if not hasattr(jx, "eqns"):
+        if hasattr(jx, "jaxpr"):            # ClosedJaxpr
+            jx = jx.jaxpr
+        else:
+            jx = jax.make_jaxpr(fn_or_jaxpr)(*args, **kwargs).jaxpr
+    recs = []
+
+    def visit(j, in_loop):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in _REDUCE_PRIMS or name == "all_gather":
+                axes = eqn.params.get("axes",
+                                      eqn.params.get("axis_name", ()))
+                if isinstance(axes, str):
+                    axes = (axes,)
+                nbytes = sum(
+                    int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+                    for v in eqn.invars if hasattr(v, "aval"))
+                recs.append({"prim": name, "axes": tuple(axes),
+                             "bytes": nbytes, "in_loop": in_loop})
+            inner_loop = in_loop or name in _LOOP_PRIMS
+            for v in eqn.params.values():
+                for u in (v if isinstance(v, (list, tuple)) else (v,)):
+                    if hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
+                        visit(u.jaxpr, inner_loop)
+                    elif hasattr(u, "eqns"):
+                        visit(u, inner_loop)
+
+    visit(jx, False)
+    return recs
+
+
+def grad_collective_stats(fn_or_jaxpr, *args, dp_axes=None,
+                          min_bytes: int = 4 * MIN_SIZE_TO_SHARD):
+    """Summarise a step function's GRADIENT collectives over the dp axes:
+    reductions at least ``min_bytes`` big (gradient-leaf-sized — the
+    scalar loss pmean and [C]-sized BatchNorm statistic psums fall under
+    the floor and are not gradient traffic). Returns ``{"boundary": n,
+    "in_loop": n, "bytes": total}`` — the grad-accum contract is
+    ``in_loop == 0`` and ``boundary``/``bytes`` independent of the
+    accumulation factor N (tests/test_grad_accum.py; bench.py's
+    ``_bench_grad_accum`` smoke asserts the same counters)."""
+    recs = jaxpr_collectives(fn_or_jaxpr, *args)
+    out = {"boundary": 0, "in_loop": 0, "bytes": 0}
+    for r in recs:
+        if r["prim"] == "all_gather" or r["bytes"] < min_bytes:
+            continue
+        if dp_axes is not None and not set(r["axes"]) & set(dp_axes):
+            continue
+        out["in_loop" if r["in_loop"] else "boundary"] += 1
+        out["bytes"] += r["bytes"]
+    return out

@@ -83,10 +83,19 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
     AdamW+warmup-cosine for the transformer rungs.
 
     ``clip_norm``: global-gradient-norm clip (0 = off), applied before the
-    optimizer. ``grad_accum``: accumulate N micro-step gradients before
-    each parameter update (``optax.MultiSteps``) — N-times the effective
-    batch at constant activation memory. Neither composes with
-    ``adamw_fused`` (its single-pass kernel bypasses the update chain).
+    optimizer. ``grad_accum``: the LEGACY ``optax.MultiSteps``
+    accumulation path — N micro-step ``update`` calls per parameter
+    update, kept for direct callers that drive one train_step per
+    micro-batch (and for its mid-accumulation checkpoint semantics,
+    ``tests/test_optim_extras.py``). The trainer no longer routes
+    ``--grad_accum`` here: it selects STEP-LEVEL accumulation
+    (``make_step_fns(accum_steps=N)``, ``train/step.py``), which pays one
+    gradient reduction per update inside the compiled step, keeps
+    activation memory at one microbatch, and composes with
+    ``adamw_fused``. Only this legacy path is incompatible with
+    ``adamw_fused`` (the single-pass kernel bypasses the optax update
+    chain MultiSteps lives in); ``clip_norm``/``weight_decay`` don't
+    compose with it on either path (no decay-mask in the kernel).
     """
     total = kw.pop("total_steps", steps_per_epoch * 10)
     if name == "adamw_fused" and (clip_norm > 0 or grad_accum > 1
@@ -95,8 +104,18 @@ def build_optimizer(name: str, lr: float, gamma: float, steps_per_epoch: int,
             "adamw_fused bypasses the optax update chain (and its kernel "
             "has no decay-mask path, so weight_decay would hit biases and "
             "norm scales too); use --optimizer adamw with "
-            "--clip_norm/--grad_accum/--weight_decay")
+            "--clip_norm/--weight_decay. For gradient accumulation, "
+            "adamw_fused DOES compose with the step-level path "
+            "(--grad_accum via the trainer / make_step_fns accum_steps) — "
+            "only this legacy optax-MultiSteps grad_accum is unsupported")
     if grad_accum > 1:
+        import warnings
+        warnings.warn(
+            "build_optimizer(grad_accum>1) is the legacy optax.MultiSteps "
+            "path (one gradient reduction per MICRO-step); step-level "
+            "accumulation (make_step_fns(accum_steps=N) / the trainer's "
+            "--grad_accum) reduces once per update and supersedes it",
+            DeprecationWarning, stacklevel=2)
         # schedules are indexed by UPDATE count: MultiSteps advances the
         # inner transformation once per accumulated update, so horizons
         # given in feeder micro-steps must shrink by the accumulation

@@ -66,7 +66,8 @@ class TrainState:
 def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                   strategy=None, donate: bool = True, compute_dtype=None,
                   augment=None, shard_update: bool | None = None,
-                  quant_collectives: bool = False):
+                  quant_collectives: bool = False, accum_steps: int = 1,
+                  accum_dtype=None, accum_bucket_mb: float | None = None):
     """Build ``(init_fn, train_step, eval_step)`` for ``model`` on ``mesh``.
 
     ``strategy`` decides parameter layout (default pure DP = replicated,
@@ -104,6 +105,29 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     over fixed-size shards reproduce the exact-path loss, and gradients
     differ by the collective's bounded quantization error
     (tests/test_collectives.py).
+
+    ``accum_steps`` — STEP-LEVEL gradient accumulation (the SPMD analog
+    of DDP ``no_sync``, arXiv:1810.11112): the global batch ``[B, ...]``
+    is split into ``accum_steps`` microbatches and a ``lax.scan`` inside
+    the compiled step accumulates **local, un-reduced** gradients in
+    ``accum_dtype`` (f32 default, bf16 opt-in), paying exactly ONE dp
+    gradient reduction per optimizer update at the scan boundary instead
+    of one per microbatch. Under the ``DataParallel`` strategy with
+    dp > 1 the whole step runs inside a dp-manual shard_map so the
+    boundary reduction is explicit — plain psum, ZeRO-1 reduce-scatter
+    (``shard_update``), or ``quantized_reduce_scatter``
+    (``quant_collectives``) — and provable at the jaxpr level
+    (``collectives.grad_collective_stats``); the boundary is pipelined
+    over parameter buckets (``accum_bucket_mb``, DDP's bucket_cap_mb
+    move: bucket k's reduce-scatter overlaps bucket k-1's optimizer
+    update + all-gather; 0 disables). Activation memory stays at ONE
+    microbatch (composes with remat'd models); ``adamw_fused`` composes
+    (accumulation no longer lives in the optax chain); BatchNorm models
+    keep sync-BN statistics, updated once per microbatch
+    (``models/layers.py::BatchNorm``, ``tests/test_batchnorm.py``).
+    Other strategies (FSDP/TP, or dp == 1) take an automatic-partitioner
+    scan: same one-compiled-step / one-microbatch-activations contract,
+    but the collective placement is the partitioner's.
     """
     strategy = strategy or DataParallel()
     fused_opt = hasattr(tx, "fused_apply")
@@ -132,6 +156,27 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                 "params)")
         if zero1 and dp_n <= 1:
             zero1 = False
+    accum_steps = int(accum_steps or 1)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    accum_dtype = jnp.dtype(accum_dtype if accum_dtype is not None
+                            else jnp.float32)
+    if accum_dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(
+            f"accum_dtype must be float32 or bfloat16, got {accum_dtype}")
+    # the boundary-reduction (manual) accumulation path: pure DP with a
+    # real dp axis — elsewhere (FSDP/TP layouts, dp=1) the automatic
+    # partitioner owns collective placement and accumulation is a plain
+    # scan (see _accum_auto_step)
+    accum_manual = (accum_steps > 1 and isinstance(strategy, DataParallel)
+                    and dp_n > 1)
+    bucket_bytes = ((coll.DEFAULT_BUCKET_MB if accum_bucket_mb is None
+                     else accum_bucket_mb) * 1e6)
+    if not elementwise:
+        # a global-norm clip couples every leaf: the boundary update must
+        # see the whole gradient at once (single bucket; still one
+        # reduction per update — only the overlap pipelining is off)
+        bucket_bytes = 0
     if quant_collectives:
         if not zero1:
             raise ValueError(
@@ -352,11 +397,183 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
         with use_mesh(mesh), use_manual_axes((ax,)), _layout_ctx():
             return fn(params, opt_state, x, y, rng_data)
 
+    def _micro_loss_fn(p, ms, xm, ym, k):
+        """One microbatch's loss closure over fixed params ``p`` —
+        shared by both accumulation paths. Returns ``(loss, new_ms)``."""
+        xm = _cast(xm)
+        if augment is not None:
+            # same dedicated-key discipline as the non-accum step
+            xm = augment(xm, jax.random.fold_in(k, 0x41554747))
+        if hasattr(model, "train_loss"):
+            return model.train_loss(_cast_params(p), ms, xm, ym, rng=k)
+        out, new_ms = model.apply(_cast_params(p), ms, xm, train=True,
+                                  rng=k)
+        return model.loss_fn(out, ym), new_ms
+
+    def _micro_scan(params, mstate, xs, ys, rng):
+        """``lax.scan`` over the microbatches: accumulate local
+        (un-reduced on the manual path) gradients in ``accum_dtype``,
+        thread ``model_state`` so BatchNorm statistics see every
+        microbatch in sequence (N reference steps' worth of running-stat
+        updates), and fold the microbatch index into the rng so each
+        microbatch draws its own dropout/augment masks."""
+
+        def micro(carry, inp):
+            acc, ms = carry
+            xm, ym, i = inp
+            k = jax.random.fold_in(rng, i)
+            (loss, new_ms), g = jax.value_and_grad(
+                _micro_loss_fn, has_aux=True)(params, ms, xm, ym, k)
+            acc = jax.tree.map(lambda a, gl: a + gl.astype(a.dtype),
+                               acc, g)
+            return (acc, new_ms), loss
+
+        acc0 = jax.tree.map(lambda l: jnp.zeros(l.shape, accum_dtype),
+                            params)
+        (gsum, new_ms), losses = lax.scan(
+            micro, (acc0, mstate), (xs, ys, jnp.arange(accum_steps)))
+        return gsum, new_ms, losses
+
+    def _accum_manual_step(state: TrainState, x, y, step_rng):
+        """Step-level accumulation under pure DP: the whole step runs in
+        ONE shard_map manual over the dp axes. Each rank scans its local
+        microbatch shards accumulating honest per-rank gradients with NO
+        cross-replica traffic (DDP ``no_sync``); the scan boundary then
+        pays the update's single reduction per leaf — psum for
+        replicated leaves, reduce-scatter into the ZeRO-1 update shard
+        for sharded ones, the block-scaled int8 exchange under
+        ``quant_collectives`` — pipelined over parameter buckets so
+        bucket k's collective rides under bucket k-1's optimizer update
+        and param all-gather. The jaxpr therefore contains zero
+        grad-sized dp collectives inside the scan and exactly one per
+        leaf at the boundary, for any N
+        (``collectives.grad_collective_stats``)."""
+        params, opt_state = state.params, state.opt_state
+        if zero1:
+            p_specs = coll.tree_update_specs(params, dp_n, dp_ax)
+            o_specs = coll.tree_update_specs(opt_state, dp_n, dp_ax)
+        else:
+            p_specs = jax.tree.map(lambda _: P(), params)
+            o_specs = jax.tree.map(lambda _: P(), opt_state)
+        ax_spec = dp_ax if len(dp_ax) > 1 else dp_ax[0]
+        buckets = coll.bucketize(params, bucket_bytes)
+        rng_data = jax.random.key_data(step_rng)
+        mstate = state.model_state
+        repl_ms = jax.tree.map(lambda _: P(), mstate)
+
+        def body(p, o, ms, xs, ys, rd):
+            rng = jax.random.wrap_key_data(rd)
+            # per-rank streams: the auto partitioner slices ONE global
+            # dropout/augment mask across ranks; inside the manual
+            # region each rank draws its own, so fold the rank in
+            for a in dp_ax:
+                rng = jax.random.fold_in(rng, lax.axis_index(a))
+            xs = xs.reshape((accum_steps, xs.shape[0] // accum_steps)
+                            + xs.shape[1:])
+            ys = ys.reshape((accum_steps, ys.shape[0] // accum_steps)
+                            + ys.shape[1:])
+            gsum, new_ms, losses = _micro_scan(p, ms, xs, ys, rng)
+            # global mean loss = mean of the equal-size per-rank,
+            # per-microbatch means
+            loss = lax.psum(jnp.mean(losses), dp_ax) / dp_n
+            scale = 1.0 / (accum_steps * dp_n)
+
+            def reduce_leaf(gl, spec, pl):
+                d = coll.spec_shard_dim(spec)
+                if d is None:
+                    red = lax.psum(gl, dp_ax)
+                elif quant_collectives:
+                    red = coll.quantized_reduce_scatter(gl, dp_ax[0],
+                                                        dp_n, dim=d)
+                else:
+                    red = coll.reduce_scatter(gl, ax_spec, dim=d)
+                return (red.astype(jnp.float32) * scale).astype(pl.dtype)
+
+            def slice_leaf(pl, spec):
+                d = coll.spec_shard_dim(spec)
+                return pl if d is None else coll.shard_slice(
+                    pl, ax_spec, dp_n, dim=d)
+
+            def gather_leaf(pl, spec):
+                d = coll.spec_shard_dim(spec)
+                return pl if d is None else coll.all_gather(pl, ax_spec,
+                                                            dim=d)
+
+            new_p, new_o = coll.bucketed_update(
+                gsum, o, p, p_specs, buckets,
+                reduce_leaf=reduce_leaf, slice_leaf=slice_leaf,
+                gather_leaf=gather_leaf, update_fn=_local_update)
+            return new_p, new_o, new_ms, loss
+
+        repl_p = jax.tree.map(lambda _: P(), params)
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(repl_p, o_specs, repl_ms,
+                                 P(ax_spec), P(ax_spec), P()),
+                       out_specs=(repl_p, o_specs, repl_ms, P()),
+                       axis_names=set(dp_ax))
+        # use_manual_axes: constrain() pins AND BatchNorm's sync-stat
+        # pmean (models/layers.py) key off the declared manual dp axes
+        with use_mesh(mesh), use_manual_axes(dp_ax), _layout_ctx():
+            new_p, new_o, new_ms, loss = fn(params, opt_state, mstate,
+                                            x, y, rng_data)
+        if zero1:
+            repl = NamedSharding(mesh, P())
+            new_p = jax.tree.map(
+                lambda a: lax.with_sharding_constraint(a, repl), new_p)
+        return new_p, new_o, new_ms, loss
+
+    def _accum_auto_step(state: TrainState, x, y, step_rng):
+        """Step-level accumulation under the automatic partitioner
+        (FSDP/TP layouts, or dp == 1): one compiled step, activation
+        memory of one microbatch, schedules advancing per UPDATE — but
+        collective placement belongs to the partitioner, so the
+        one-boundary-reduction guarantee is NOT made here (under FSDP
+        the per-microbatch reduce-scatter is structural: gradients must
+        land in the parameter shards the backward produces them for)."""
+        B = x.shape[0]
+        xs = x.reshape((accum_steps, B // accum_steps) + x.shape[1:])
+        ys = y.reshape((accum_steps, B // accum_steps) + y.shape[1:])
+        bspec = batch_sharding(mesh, 1).spec[0]
+        if bspec is not None:
+            # keep each microbatch batch-sharded: the reshape must not
+            # gather microbatch rows onto one device
+            xs = lax.with_sharding_constraint(xs, NamedSharding(
+                mesh, P(None, bspec, *([None] * (xs.ndim - 2)))))
+            ys = lax.with_sharding_constraint(ys, NamedSharding(
+                mesh, P(None, bspec, *([None] * (ys.ndim - 2)))))
+        with use_mesh(mesh), _layout_ctx():
+            gsum, new_ms, losses = _micro_scan(state.params,
+                                               state.model_state,
+                                               xs, ys, step_rng)
+        grads = jax.tree.map(
+            lambda g, pl: (g.astype(jnp.float32)
+                           / accum_steps).astype(pl.dtype),
+            gsum, state.params)
+        new_p, new_o = _local_update(grads, state.opt_state, state.params)
+        return new_p, new_o, new_ms, jnp.mean(losses)
+
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, x, y):
         """One optimization step == reference ``train`` body (``main.py:57-63``)."""
-        x = _cast(x)
         step_rng = jax.random.fold_in(state.rng, state.step)
+        if accum_steps > 1:
+            div = accum_steps * (dp_n if accum_manual else 1)
+            if x.shape[0] % div:
+                raise ValueError(
+                    f"grad accumulation needs the global batch "
+                    f"({x.shape[0]}) divisible by accum_steps"
+                    f"{' x dp world size' if accum_manual else ''} "
+                    f"({div}); pick a batch/accum combination that "
+                    f"divides evenly")
+            step_fn = (_accum_manual_step if accum_manual
+                       else _accum_auto_step)
+            new_params, new_opt_state, new_mstate, loss = step_fn(
+                state, x, y, step_rng)
+            new_state = state.replace(
+                step=state.step + 1, params=new_params,
+                model_state=new_mstate, opt_state=new_opt_state)
+            return new_state, {"loss": loss.astype(jnp.float32)}
+        x = _cast(x)
         if augment is not None:
             # dedicated key: the model's rng stream is unchanged whether or
             # not augmentation is on

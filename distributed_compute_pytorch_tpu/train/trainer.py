@@ -77,29 +77,48 @@ class Trainer:
                                synthetic_fallback=fallback_ok,
                                download=config.download, **data_kw))
 
-        def _feeder(data, shuffle):
+        def _feeder(data, shuffle, batch):
             """In-memory datasets fancy-index through DeviceFeeder; sharded
             on-disk datasets stream with bounded RAM (VERDICT r2 missing #1:
             the ResNet-50/ImageNet rung needs data larger than host memory)."""
             cls = (StreamingDeviceFeeder
                    if isinstance(data, ShardedFileDataset) else DeviceFeeder)
-            return cls(data, self.mesh, config.batch_size, shuffle=shuffle,
+            return cls(data, self.mesh, batch, shuffle=shuffle,
                        seed=config.seed, prefetch=config.prefetch)
 
-        self.train_feed = _feeder(self.train_data, True)
-        self.eval_feed = _feeder(self.eval_data, False)
+        # STEP-LEVEL gradient accumulation (train/step.py accum_steps):
+        # the feeder delivers the full EFFECTIVE batch (micro x accum) and
+        # the compiled step splits it into microbatches — one train_step
+        # dispatch AND one gradient reduction per update, vs the legacy
+        # optax-MultiSteps path's N of each. --batch_size keeps its
+        # meaning as the microbatch (activation-memory) size, so the
+        # effective batch is still N x batch_size; step counts
+        # (log_every, checkpoint_every, steps_per_epoch) now tick per
+        # UPDATE, which is also what the LR schedules index.
+        self.accum = max(1, int(config.grad_accum))
+        self.train_feed = _feeder(self.train_data, True,
+                                  config.batch_size * self.accum)
+        self.eval_feed = _feeder(self.eval_data, False, config.batch_size)
+        if self.accum > 1:
+            log0(f"grad_accum={self.accum}: step-level accumulation — "
+                 f"effective batch {config.batch_size * self.accum} "
+                 f"({self.accum} x {config.batch_size} microbatches, one "
+                 f"gradient reduction per update); steps count updates")
 
         self.model = model if model is not None else build_model(
             config.model, **self._model_kwargs())
         self.strategy = (strategy if strategy is not None
                          else self._pick_strategy())
 
+        # grad_accum is NOT passed down: schedules already tick per
+        # update (the feeder batch is the effective batch), and the
+        # legacy MultiSteps wrapper is superseded by accum_steps below
         self.tx = build_optimizer(
             config.optimizer, config.lr, config.gamma,
             steps_per_epoch=self.train_feed.steps_per_epoch,
             total_steps=self.train_feed.steps_per_epoch * config.epochs,
             weight_decay=config.weight_decay, clip_norm=config.clip_norm,
-            grad_accum=config.grad_accum, warmup_steps=config.warmup_steps)
+            warmup_steps=config.warmup_steps)
         compute_dtype = (None if config.compute_dtype in (None, "float32")
                          else jnp.dtype(config.compute_dtype))
         augment = None
@@ -112,11 +131,19 @@ class Trainer:
                 log0(f"WARNING: --augment {config.augment} needs image "
                      f"(rank-4) inputs; {config.dataset!r} provides rank "
                      f"{self.train_data.inputs.ndim} — ignored")
+        accum_dtype = {"float32": jnp.float32, "f32": jnp.float32,
+                       "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16}.get(
+                           config.accum_dtype)
+        if accum_dtype is None:
+            raise ValueError(f"--accum_dtype must be float32|bfloat16, "
+                             f"got {config.accum_dtype!r}")
         self.init_fn, self.train_step, self.eval_step = make_step_fns(
             self.model, self.tx, self.mesh, self.strategy,
             donate=config.donate, compute_dtype=compute_dtype,
             augment=augment, shard_update=self._resolve_shard_update(),
-            quant_collectives=config.quant_collectives)
+            quant_collectives=config.quant_collectives,
+            accum_steps=self.accum, accum_dtype=accum_dtype,
+            accum_bucket_mb=config.accum_bucket_mb)
         # interleaved-pipeline runs keep the LIVE state's blocks in the
         # strided storage layout; checkpoints stay logical — these
         # converters sit at the save/restore boundaries (None otherwise)
@@ -389,7 +416,8 @@ class Trainer:
         if metrics is not None:
             np.asarray(metrics["loss"])
         secs = timer.elapsed()
-        return (steps - skip) * cfg.batch_size / secs
+        # each update consumes the full effective batch (micro x accum)
+        return (steps - skip) * cfg.batch_size * self.accum / secs
 
     def _should_preempt(self, guard, global_step: int) -> bool:
         """Per-step preemption poll. Single-host: the local signal flag.

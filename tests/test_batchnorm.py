@@ -71,6 +71,85 @@ def test_bn_running_stats_torch_momentum():
                                rtol=1e-4, atol=1e-5)
 
 
+class _BNNet:
+    """Minimal stateful model (Dense <- BN) exercising the make_step_fns
+    contract without dropout, so statistics are the only stochasticity-
+    free state to compare."""
+
+    def __init__(self, d=4, classes=3):
+        self.bn = L.BatchNorm(d)
+        self.d, self.classes = d, classes
+
+    def init(self, key):
+        key = jax.random.key(0) if key is None else key
+        w = jax.random.normal(key, (self.d, self.classes)) * 0.1
+        return ({"bn": self.bn.init(None), "w": w},
+                {"bn": self.bn.init_state()})
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        del rng
+        h, bn_state = self.bn.apply(params["bn"], state["bn"], x,
+                                    train=train)
+        return h @ params["w"], {"bn": bn_state}
+
+    def loss_fn(self, out, y):
+        logp = jax.nn.log_softmax(out)
+        return -jnp.take_along_axis(logp, y[:, None], 1).mean()
+
+
+def test_bn_accum_stats_match_sequential_microbatches(devices8):
+    """THE BatchNorm semantics under step-level gradient accumulation
+    (train/step.py ``accum_steps``): the microbatch scan threads
+    ``model_state`` through, so the running statistics see EVERY
+    microbatch in sequence — exactly N sequential sync-BN reference
+    steps at fixed params — and each microbatch's batch statistics are
+    GLOBAL across the dp shards (the manual-region pmean in
+    models/layers.py restores sync-BN where the partitioner can't see
+    the batch dim). Pinned against a single-device sequential replay of
+    the same microbatch partition."""
+    from distributed_compute_pytorch_tpu.train.optim import build_optimizer
+    from distributed_compute_pytorch_tpu.train.step import make_step_fns
+
+    dp, N, B, d = 4, 2, 16, 4
+    mesh = make_mesh("data=4", devices=jax.devices()[:4])
+    model = _BNNet(d=d)
+    x_host = np.random.default_rng(0).normal(size=(B, d)).astype(np.float32)
+    # deliberately non-iid across dp shards AND microbatches: shard-local
+    # or last-microbatch-only statistics would diverge hard
+    x_host += np.repeat(np.arange(B // 4), 4).reshape(B, 1)
+    y_host = np.asarray(np.arange(B) % 3, np.int32)
+    x = jax.device_put(jnp.asarray(x_host), batch_sharding(mesh, 2))
+    y = jax.device_put(jnp.asarray(y_host), batch_sharding(mesh, 1))
+
+    tx = build_optimizer("sgd", lr=0.1, gamma=1.0, steps_per_epoch=10,
+                         momentum=0.0)
+    init_fn, train_step, _ = make_step_fns(model, tx, mesh, donate=False,
+                                           accum_steps=N)
+    state = init_fn(jax.random.key(0))
+    params0 = jax.device_get(state.params)
+    new_state, _ = train_step(state, x, y)
+
+    # reference: the SAME microbatch partition (microbatch n = each dp
+    # rank's n-th local chunk), replayed sequentially on one device with
+    # global statistics — N reference sync-BN steps at fixed params
+    Bl, b = B // dp, B // (dp * N)
+    ms = {"bn": model.bn.init_state()}
+    for n in range(N):
+        rows = np.concatenate([
+            x_host[r * Bl + n * b: r * Bl + (n + 1) * b]
+            for r in range(dp)])
+        _, ms = model.apply(params0, ms, jnp.asarray(rows), train=True)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(new_state.model_state)["bn"]["mean"]),
+        np.asarray(ms["bn"]["mean"]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(new_state.model_state)["bn"]["var"]),
+        np.asarray(ms["bn"]["var"]), rtol=1e-5, atol=1e-6)
+    # and the stats moved: every microbatch contributed, not just one
+    assert not np.allclose(np.asarray(ms["bn"]["mean"]), 0.0)
+
+
 def test_channel_dropout_zeroes_whole_channels():
     """Dropout2d semantics (reference main.py:25): the mask broadcasts over
     spatial dims, so a dropped channel is zero everywhere in that example."""

@@ -439,6 +439,7 @@ def test_supervise_first_beat_timeout_kills_silent_child(tmp_path):
     assert time.time() - t0 < 60
 
 
+@pytest.mark.slow
 def test_supervise_first_beat_timeout_tolerates_slow_start(tmp_path):
     """A child that beats within the window is NOT killed — even when it
     then runs well PAST the window (the timer must disarm on the first

@@ -305,8 +305,14 @@ def _sharded(model, params, mesh):
 
 
 @pytest.mark.parametrize("name,model", _models())
-@pytest.mark.parametrize("spec", ["data=4,tensor=2", "fsdp=8",
-                                  "data=2,fsdp=2,tensor=2"])
+# pure-fsdp generation is marked slow (tier-1 budget): the 3-axis case
+# below shards params over fsdp too AND is the partitioner-fragility
+# guard, so fsdp=8 adds wall time but no unique layout coverage;
+# `make test` still runs it
+@pytest.mark.parametrize("spec", [
+    "data=4,tensor=2",
+    pytest.param("fsdp=8", marks=pytest.mark.slow),
+    "data=2,fsdp=2,tensor=2"])
 def test_mesh_generate_matches_full_forward(name, model, spec, devices8):
     """The gold parity test, SHARDED: cached generation under a mesh ==
     greedily decoding with a full forward per step under the SAME mesh,

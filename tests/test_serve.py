@@ -154,7 +154,14 @@ def test_long_stream_outlives_lockstep_horizon():
         assert out == want, (i, out, want)
 
 
-@pytest.mark.parametrize("name,model", _models())
+# the MoE long-stream case is marked slow (tier-1 budget): row
+# recycling is family-independent host logic pinned by the gpt2/llama
+# cases here, and MoE serving exactness keeps its own tier-1 coverage
+# (test_staggered_admissions_match_standalone[moe],
+# test_moe_no_drop_contract_exact_parity); `make test` runs it
+@pytest.mark.parametrize("name,model", [
+    pytest.param(*m, marks=pytest.mark.slow) if m[0] == "moe" else m
+    for m in _models()])
 def test_long_stream_all_families(name, model):
     """Mixed-length stream needing more total ticks than t_max, through
     2 slots — row recycling must stay exact for every family (learned
