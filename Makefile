@@ -24,7 +24,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 test bench bench-smoke
+.PHONY: tier1 test bench bench-smoke serve-chaos-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -48,7 +48,16 @@ bench:
 #   boundary vs bucketed boundary); fails unless the compiled update
 #   holds ZERO grad collectives inside the microbatch scan, wire bytes
 #   per update drop N x, and one fused dispatch beats N legacy ones
+# - serve-chaos: the fault-tolerance drill — injected harvest fault at
+#   segment 2 on a 1-fault schedule; fails unless recovery completes
+#   (all requests ok), the recovered streams are token-identical to a
+#   fault-free run, goodput under the fault stays > 0, and no cache
+#   row leaks its slot; records recovery time
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --zero1-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-smoke
 	JAX_PLATFORMS=cpu python bench.py --grad-accum-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
+
+serve-chaos-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke

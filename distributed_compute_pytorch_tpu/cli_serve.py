@@ -19,9 +19,23 @@ or, with ``--tokenizer``, ``{"text": "..."}`` lines / raw text lines.
 JSON requests may also carry per-request sampling settings
 (``"temperature"``, ``"top_k"``, ``"top_p"``, ``"seed"``), overriding
 the CLI defaults — requests with different settings decode side by
-side in the same compiled segment. Prints one JSON line per request,
-in input order: {"prompt": [...], "new": [...]} (+ "text" when a
-tokenizer is given).
+side in the same compiled segment — and a per-request wall-clock
+``"deadline"`` (seconds). Prints one JSON line per request, in input
+order: {"prompt": [...], "new": [...], "status": "ok"} (+ "text" when
+a tokenizer is given; + "error" for non-ok outcomes).
+
+Serving is FAULT-TOLERANT per request (``serve.serve_detailed``): a
+request fails, times out (``--request_deadline`` default /
+per-request ``"deadline"``), is shed under overload
+(``--max_pending``), or is cut by a drain — the rest keep their
+tokens. SIGTERM/SIGINT drains gracefully: admission stops, in-flight
+rows finish within ``--drain_deadline``, every completed output is
+still printed, and the process exits 75 (``EXIT_PREEMPTED``, same as
+the trainer's preemption contract). A device fault mid-stream
+triggers session reconstruction (token-identical resume from
+host-tracked state); ``--fault_at_segment``/``--fault_mode`` inject
+faults to drill exactly that path, the serving analogue of
+``dcp-train --fault_at_step``.
 
 ``--mesh`` serves SHARDED (same spec language as ``dcp-generate``):
 the checkpoint restores straight into the mesh layout, cache rows
@@ -71,7 +85,8 @@ def _read_requests(path: str, tok, default_new: int, defaults: dict):
             if not isinstance(new, int) or new < 1:
                 raise SystemExit(f"requests line {i + 1}: max_new must "
                                  f"be a positive integer, got {new!r}")
-            for k in ("temperature", "top_k", "top_p", "seed"):
+            for k in ("temperature", "top_k", "top_p", "seed",
+                      "deadline"):
                 if k in obj:
                     sampling[k] = obj[k]
             if sampling["temperature"] == 0.0 and (
@@ -151,6 +166,39 @@ def main(argv=None) -> int:
                    help="admission order: strict FIFO (fairness: no "
                         "request is leapfrogged) or skip-fit (a free row "
                         "takes the first queued request that fits)")
+    # --- fault tolerance (serve_detailed; module docstring) ---
+    p.add_argument("--max_pending", type=int, default=None,
+                   help="bounded admission: accept at most slots + N "
+                        "requests, shed the rest at submission with "
+                        "zero device work (default: unbounded)")
+    p.add_argument("--request_deadline", type=float, default=None,
+                   help="default per-request wall-clock deadline in "
+                        "seconds (JSON requests may override with "
+                        "'deadline'); expired requests return their "
+                        "partial stream with status 'timeout'")
+    p.add_argument("--drain_deadline", type=float, default=30.0,
+                   help="graceful-drain budget after SIGTERM/SIGINT: "
+                        "in-flight rows get this many seconds to "
+                        "finish before returning partial streams")
+    p.add_argument("--tick_timeout", type=float, default=None,
+                   help="tick watchdog: seconds a segment's token "
+                        "harvest may block before the device is "
+                        "declared hung and the session reconstructed "
+                        "(default: no watchdog)")
+    p.add_argument("--max_recoveries", type=int, default=2,
+                   help="session reconstructions to attempt per run "
+                        "before failing the remaining requests")
+    p.add_argument("--fault_at_segment", type=int, default=None,
+                   help="fault injection (testing): trip --fault_mode "
+                        "at the Nth dispatched segment")
+    p.add_argument("--fault_mode", default="raise",
+                   choices=("raise", "hang", "slow", "poison"),
+                   help="injected fault flavour (serve_lifecycle."
+                        "ChaosInjector); 'poison' needs "
+                        "--poison_request")
+    p.add_argument("--poison_request", type=int, default=None,
+                   help="request index that deterministically poisons "
+                        "its row (with --fault_mode poison)")
     p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
     args = p.parse_args(argv)
 
@@ -159,6 +207,14 @@ def main(argv=None) -> int:
     if args.temperature == 0.0 and (args.top_k is not None
                                     or args.top_p is not None):
         raise SystemExit("--top_k/--top_p require --temperature > 0")
+    # SIGTERM/SIGINT -> graceful drain, armed BEFORE the heavy imports /
+    # checkpoint load / compiles so a preemption at ANY point of startup
+    # drains instead of dying mid-load (the trainer's PreemptionGuard,
+    # reused: first signal latches the flag, a second one kills)
+    from distributed_compute_pytorch_tpu.train.elastic import (
+        EXIT_PREEMPTED, PreemptionGuard)
+    guard = PreemptionGuard()
+    guard.__enter__()
     if args.force_cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -180,7 +236,8 @@ def main(argv=None) -> int:
         if args.eos_id is None:
             args.eos_id = tok.eos_id
     defaults = {"temperature": args.temperature, "top_k": args.top_k,
-                "top_p": args.top_p, "seed": None}
+                "top_p": args.top_p, "seed": None,
+                "deadline": args.request_deadline}
     reqs = _read_requests(args.requests, tok, args.max_new_tokens,
                           defaults)
 
@@ -213,24 +270,46 @@ def main(argv=None) -> int:
     cb = ContinuousBatcher(model, params, slots=args.slots, t_max=t_max,
                            prompt_buf=prompt_buf, segment=args.segment,
                            eos_id=args.eos_id, mesh=mesh,
-                           admit_policy=args.admit_policy)
+                           admit_policy=args.admit_policy,
+                           max_pending=args.max_pending,
+                           tick_timeout_s=args.tick_timeout,
+                           max_recoveries=args.max_recoveries)
 
     def req_seed(i, r):
         if r["seed"] is not None:
             return r["seed"]
         return None if args.seed is None else args.seed + i
 
-    outs = cb.serve([
-        Request(list(r["tokens"]), r["max_new"],
-                temperature=r["temperature"], top_k=r["top_k"],
-                top_p=r["top_p"], seed=req_seed(i, r))
-        for i, r in enumerate(reqs)])
-    for r, new in zip(reqs, outs):
-        rec = {"prompt": r["tokens"], "new": new}
+    chaos = None
+    if args.fault_at_segment is not None or args.poison_request is not None:
+        from distributed_compute_pytorch_tpu.serve_lifecycle import (
+            ChaosInjector)
+        chaos = ChaosInjector(fault_at_segment=args.fault_at_segment,
+                              fault_mode=args.fault_mode,
+                              poison_request=args.poison_request)
+
+    try:
+        results = cb.serve_detailed(
+            [Request(list(r["tokens"]), r["max_new"],
+                     temperature=r["temperature"], top_k=r["top_k"],
+                     top_p=r["top_p"], seed=req_seed(i, r),
+                     deadline_s=r["deadline"])
+             for i, r in enumerate(reqs)],
+            drain=guard, drain_deadline_s=args.drain_deadline,
+            chaos=chaos)
+    finally:
+        guard.__exit__()
+    for r, res in zip(reqs, results):
+        rec = {"prompt": r["tokens"], "new": res.tokens,
+               "status": res.status}
+        if res.error is not None:
+            rec["error"] = res.error
         if tok is not None:
-            rec["text"] = tok.decode(new)
+            rec["text"] = tok.decode(res.tokens)
         print(json.dumps(rec))
-    return 0
+    if guard.preempted:
+        return EXIT_PREEMPTED
+    return 0 if all(r.ok for r in results) else 1
 
 
 if __name__ == "__main__":

@@ -78,6 +78,11 @@ class Config:
     ckpt_sharded: bool = False     # v2 directory format: each host writes its
                                    # own shards, no O(params) gather (FSDP-scale)
     async_checkpoint: bool = False  # overlap the checkpoint write with training
+    keep_last: int = 1             # checkpoint retention: keep the last N
+                                   # checkpoints (v1: rotated .prev-K files;
+                                   # v2: last N generations) — restore falls
+                                   # back to the newest UNCORRUPTED one
+                                   # (train/checkpoint.py integrity checksums)
 
     # --- elastic / fault tolerance (SURVEY §5.3; the reference has none) ---
     checkpoint_every: int = 0      # also checkpoint every N steps (0 = per-epoch
@@ -97,6 +102,12 @@ class Config:
     fault_at_step: int | None = None   # fault injection: trip at global step N
     fault_mode: str = "raise"      # 'raise' (crash) | 'hang' (stuck collective
                                    # stand-in); first incarnation only
+    nonfinite_policy: str = "raise"  # NaN/Inf loss or grad norm: 'raise'
+                                     # (abort at the log-cadence check) |
+                                     # 'skip' (compiled guard skips the
+                                     # update, params/opt_state stay
+                                     # bit-untouched; raise after K=10
+                                     # consecutive skips — train/step.py)
 
     # --- distributed rendezvous (replaces main.py:48-49 hard-coding) ---
     coordinator: str | None = field(
@@ -255,6 +266,21 @@ class Config:
                             "in the first incarnation")
         p.add_argument("--fault_mode", type=str, default=cls.fault_mode,
                        choices=("raise", "hang"))
+        p.add_argument("--nonfinite_policy", type=str,
+                       default=cls.nonfinite_policy,
+                       choices=("raise", "skip"),
+                       help="on NaN/Inf loss or gradient norm: 'raise' "
+                            "aborts at the next log-cadence check; "
+                            "'skip' compiles a guard that drops the bad "
+                            "update (params/opt_state bit-untouched), "
+                            "logs the skip count, and raises after 10 "
+                            "consecutive skips")
+        p.add_argument("--keep_last", type=int, default=cls.keep_last,
+                       help="checkpoint retention: keep the last N "
+                            "checkpoints and fall back to the newest "
+                            "uncorrupted one on restore (v1 files "
+                            "rotate to .prev-K; v2 directories keep N "
+                            "generations)")
         p.add_argument("--coordinator", type=str, default=None,
                        help="host:port of process 0 (multi-host rendezvous)")
         p.add_argument("--num_processes", type=int, default=None)

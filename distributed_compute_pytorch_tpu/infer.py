@@ -357,12 +357,16 @@ def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                 f"{max_new_tokens} new tokens")
         # Align t_max to the in-place Pallas slot write's window
         # (cache_update.py ``_window``: 32 sublanes for int8 tiles, 8 for
-        # bf16/f32). A misaligned t_max silently falls back to
-        # dynamic-update-slice, which COPIES the whole cache every tick —
-        # the measured 0.33 ms/tick cliff the kernel exists to avoid.
-        # Extra slots are never attended (the position mask stops at
-        # ``pos``), so rounding up is observationally free.
-        align = 32 if kv_quant else 8
+        # bf16/f32 — read from the kernel so the two can't drift). A
+        # misaligned t_max silently falls back to dynamic-update-slice,
+        # which COPIES the whole cache every tick — the measured
+        # 0.33 ms/tick cliff the kernel exists to avoid. Extra slots are
+        # never attended (the position mask stops at ``pos``), so
+        # rounding up is observationally free.
+        from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+            _window)
+        align = _window(jnp.dtype(jnp.int8) if kv_quant
+                        else jnp.dtype(jnp.float32))
         tm = -(-tm // align) * align
         model_cap = getattr(model.config, "max_seq_len", None)
         final = prompt.shape[1] + max_new_tokens

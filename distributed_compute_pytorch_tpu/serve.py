@@ -114,19 +114,42 @@ with capacity ``C`` — the paths can disagree only if the standalone
 run capacity-drops that one token (``tests/test_serve.py`` pins both
 the binding-capacity parity and this boundary).
 
+**Fault tolerance (serve_detailed — the failure domain is ONE
+request, never the process).** The legacy ``serve()`` is
+all-or-nothing; :meth:`ContinuousBatcher.serve_detailed` runs the same
+engine with the request lifecycle threaded through the host scheduler's
+decision points: per-request wall-clock deadlines and thread-safe
+:meth:`cancel` (partial streams returned), bounded admission with load
+shedding (``max_pending``), graceful drain off any ``.preempted`` flag
+(``train/elastic.PreemptionGuard``: admission stops, in-flight rows
+finish within the drain deadline, completed outputs are returned), and
+DEVICE-FAILURE SESSION RECONSTRUCTION — a raised segment/harvest or a
+harvest hung past the ``tick_timeout_s`` watchdog rebuilds every live
+row by re-prefilling ``prompt + generated-so-far`` from host-tracked
+state and resumes decode TOKEN-IDENTICALLY (host-known prefixes +
+(seed, tokens-so-far) sampling keys make replay exact; ``_reconstruct``
+carries the soundness argument, DESIGN.md "Serving under failure" the
+long form). Every request ends in a structured
+``serve_lifecycle.RequestResult``; chaos drills
+(``serve_lifecycle.ChaosInjector``, ``tests/test_serve_faults.py``,
+``bench.py --serve-chaos-smoke``) exercise each path.
+
 Instrumentation (the transport counters ``make bench-smoke`` asserts):
 ``stats`` counts segments, fetches (exactly one per segment),
 overlapped fetches (the next segment was already dispatched when the
 fetch was issued) and prefill calls/rows (one call per admission
-wave); ``waste`` attributes every non-useful row-tick to post-eos/
-budget tail, admission lag, or final drain (the serve bench's
-``waste_breakdown``).
+wave), plus the fault-tolerance counters (faults, reconstructions,
+reconstruction rows, recovery seconds); ``waste`` attributes every
+non-useful row-tick to post-eos/budget tail, admission lag, or final
+drain (the serve bench's ``waste_breakdown``).
 """
 
 from __future__ import annotations
 
 import contextlib
 import inspect
+import threading
+import time
 import warnings
 from dataclasses import dataclass, field
 
@@ -140,6 +163,9 @@ from distributed_compute_pytorch_tpu.core.mesh import (
     constrain, named_sharding, use_mesh)
 from distributed_compute_pytorch_tpu.infer import (
     _CACHE_SPEC, _constrain_cache, sample_rows)
+from distributed_compute_pytorch_tpu.serve_lifecycle import (
+    CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
+from distributed_compute_pytorch_tpu.train.elastic import call_with_timeout
 
 
 @dataclass
@@ -151,7 +177,14 @@ class Request:
     optional ``top_k``/``top_p`` truncation (both require temperature
     > 0, mirroring ``infer.generate``). ``seed`` fixes the request's
     sampling stream; ``None`` defaults to the request's index in the
-    ``serve()`` call, so a whole call is deterministic by default."""
+    ``serve()`` call, so a whole call is deterministic by default.
+
+    ``deadline_s`` is a WALL-CLOCK budget measured from submission
+    (the ``serve_detailed`` call): a request still queued when it
+    expires is finalised ``timeout`` with no device work; one
+    in-flight is cut at the next segment boundary, returning the
+    partial stream (so expiry can overshoot by up to one segment's
+    wall time). ``None`` = no deadline (the legacy contract)."""
 
     tokens: list
     max_new: int
@@ -159,6 +192,7 @@ class Request:
     top_k: int | None = None
     top_p: float | None = None
     seed: int | None = None
+    deadline_s: float | None = None
 
 
 @dataclass
@@ -168,6 +202,13 @@ class _Slot:
     req_index: int = -1        # position in the request list (-1 = free)
     remaining: int = 0
     out: list = field(default_factory=list)
+    admit_seq: int = -1        # admission order (poison-eviction heuristic)
+
+    def free(self):
+        self.req_index = -1
+        self.remaining = 0
+        self.out = []
+        self.admit_seq = -1
 
 
 class HorizonError(RuntimeError):
@@ -217,12 +258,29 @@ class ContinuousBatcher:
         FFNs; ``seq`` is rejected (decode has no sequence to shard).
       admit_policy: ``"fifo"`` (strict arrival order — the fairness
         contract in the module docstring) or ``"skip_fit"``.
+      max_pending: bounded admission — at submission, at most
+        ``slots + max_pending`` requests are accepted; the rest are
+        finalised ``shed`` with zero device work (overload rejects
+        cheaply instead of queueing unboundedly). ``None`` = unbounded
+        (the legacy contract).
+      tick_timeout_s: the tick watchdog — wall-clock budget for each
+        segment's token harvest (the loop's single device->host fetch,
+        where a dead or wedged device surfaces). On expiry the session
+        is RECONSTRUCTED (``_reconstruct``) instead of hanging forever.
+        ``None`` = no watchdog (and no per-segment worker thread).
+      max_recoveries: how many session reconstructions one
+        ``serve_detailed`` call may attempt before declaring the device
+        lost and failing the remaining requests (each carrying the
+        underlying error).
     """
 
     def __init__(self, model, params, *, slots: int, t_max: int,
                  prompt_buf: int, segment: int = 16,
                  eos_id: int | None = None, mesh=None,
-                 admit_policy: str = "fifo"):
+                 admit_policy: str = "fifo",
+                 max_pending: int | None = None,
+                 tick_timeout_s: float | None = None,
+                 max_recoveries: int = 2):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -230,6 +288,19 @@ class ContinuousBatcher:
         if admit_policy not in ("fifo", "skip_fit"):
             raise ValueError(f"admit_policy must be 'fifo' or 'skip_fit', "
                              f"got {admit_policy!r}")
+        if max_pending is not None and max_pending < 0:
+            raise ValueError(f"max_pending must be >= 0, got {max_pending}")
+        if tick_timeout_s is not None and tick_timeout_s <= 0:
+            raise ValueError(
+                f"tick_timeout_s must be > 0, got {tick_timeout_s}")
+        if max_recoveries < 0:
+            raise ValueError(
+                f"max_recoveries must be >= 0, got {max_recoveries}")
+        self.max_pending = max_pending
+        self.tick_timeout_s = tick_timeout_s
+        self.max_recoveries = max_recoveries
+        self._cancel_mu = threading.Lock()
+        self._cancelled: set[int] = set()
         self.model = model
         self.params = params
         self.B = slots
@@ -339,7 +410,17 @@ class ContinuousBatcher:
         # bench smoke): fetches == segments, every fetch with live rows
         # behind it issued AFTER the next segment's dispatch
         self.stats = {"segments": 0, "fetches": 0, "fetches_overlapped": 0,
-                      "prefill_calls": 0, "prefill_rows": 0}
+                      "prefill_calls": 0, "prefill_rows": 0,
+                      # fault-tolerance counters: faults observed (chaos
+                      # or real), sessions reconstructed, rows
+                      # re-prefilled by reconstruction waves, wall time
+                      # spent rebuilding (serve_lifecycle / DESIGN.md
+                      # "Serving under failure")
+                      "faults": 0, "reconstructions": 0,
+                      "reconstruction_rows": 0, "recovery_s": 0.0}
+        self.last_slot_leaks = 0   # rows still owned at serve() exit
+                                   # (must be 0 — asserted by tests and
+                                   # the chaos bench smoke)
         # row-tick attribution for the bench's waste_breakdown: useful
         # tokens = planned_ticks - tail (tail = post-eos + budget
         # rounding); parked ticks split by whether work was waiting
@@ -404,8 +485,16 @@ class ContinuousBatcher:
         1-row wave on data x expert, CPU SPMD — the same partitioner
         fragility ``core.mesh.constrain_activations`` documents), and
         even partitioning keeps it on the well-trodden path.
+
+        The window width is the PROMPT'S OWN (static) width, normally
+        ``prompt_buf`` — but session reconstruction after a device
+        fault re-prefills ``prompt + generated-so-far`` prefixes that
+        can outgrow ``prompt_buf``, at a wider window (each distinct
+        width compiles once, like any other admission shape; see
+        ``_reconstruct``).
         """
-        model, Tb = self.model, self.Tb
+        model = self.model
+        Tb = prompt.shape[1]
         pad_count = Tb - jnp.sum(pmask.astype(jnp.int32), axis=1)
         logical = jnp.maximum(jnp.arange(Tb)[None, :] - pad_count[:, None],
                               0)
@@ -511,27 +600,58 @@ class ContinuousBatcher:
     def _fits(self, req: Request) -> bool:
         return self.Tb + self._rounded_need(req.max_new) <= self.t_max
 
+    def _validate_one(self, r: Request) -> str | None:
+        """One request's submission-time validation; returns the error
+        string (``None`` = valid). ``serve_detailed`` turns a non-None
+        result into a structured ``failed`` outcome with ZERO device
+        work and no slot occupancy; the legacy ``serve`` raises it."""
+        if len(r.tokens) > self.Tb:
+            return (f"prompt of {len(r.tokens)} tokens exceeds "
+                    f"prompt_buf={self.Tb}")
+        if len(r.tokens) == 0:
+            return "empty prompt"
+        if r.max_new < 1:
+            return f"max_new must be >= 1, got {r.max_new}"
+        if r.temperature < 0.0:
+            return f"temperature must be >= 0, got {r.temperature}"
+        if r.temperature == 0.0 and (r.top_k is not None
+                                     or r.top_p is not None):
+            return ("top_k/top_p require temperature > 0 "
+                    "(temperature 0 is greedy)")
+        if r.top_k is not None and r.top_k < 1:
+            return f"top_k must be >= 1, got {r.top_k}"
+        if r.top_p is not None and not 0.0 < r.top_p <= 1.0:
+            return f"top_p must be in (0, 1], got {r.top_p}"
+        vocab = getattr(getattr(self.model, "config", None),
+                        "vocab_size", None)
+        if vocab is not None:
+            bad = [t for t in r.tokens if not 0 <= t < vocab]
+            if bad:
+                # JAX gather CLAMPS out-of-range ids instead of raising,
+                # so an unchecked bad id would silently decode garbage
+                return (f"token ids {bad[:8]} outside the model vocab "
+                        f"[0, {vocab})")
+        if r.deadline_s is not None and r.deadline_s <= 0:
+            return f"deadline_s must be > 0, got {r.deadline_s}"
+        return None
+
     def _validate(self, requests):
         for r in requests:
-            if len(r.tokens) > self.Tb:
-                raise ValueError(
-                    f"prompt of {len(r.tokens)} tokens exceeds "
-                    f"prompt_buf={self.Tb}")
-            if len(r.tokens) == 0:
-                raise ValueError("empty prompt")
-            if r.max_new < 1:
-                raise ValueError(f"max_new must be >= 1, got {r.max_new}")
-            if r.temperature < 0.0:
-                raise ValueError(
-                    f"temperature must be >= 0, got {r.temperature}")
-            if r.temperature == 0.0 and (r.top_k is not None
-                                         or r.top_p is not None):
-                raise ValueError("top_k/top_p require temperature > 0 "
-                                 "(temperature 0 is greedy)")
-            if r.top_k is not None and r.top_k < 1:
-                raise ValueError(f"top_k must be >= 1, got {r.top_k}")
-            if r.top_p is not None and not 0.0 < r.top_p <= 1.0:
-                raise ValueError(f"top_p must be in (0, 1], got {r.top_p}")
+            err = self._validate_one(r)
+            if err is not None:
+                raise ValueError(err)
+
+    def cancel(self, request_index: int) -> None:
+        """Cancel one request of the serve call currently in flight, by
+        its index in that call's request list. Thread-safe — a server
+        front-end calls this from another thread; tests from a chaos
+        ``on_segment`` hook. A still-queued request is finalised
+        ``cancelled`` with no device work; an in-flight one is cut at
+        the next segment boundary and returns its partial tokens.
+        Unknown or already-finished indices are ignored; the set clears
+        when a new serve call starts."""
+        with self._cancel_mu:
+            self._cancelled.add(int(request_index))
 
     def serve(self, requests: list[Request]) -> list[list[int]]:
         """Run every request through the pool; returns each request's
@@ -542,29 +662,195 @@ class ContinuousBatcher:
         rejected: everything else is served to completion FIRST, then
         :class:`HorizonError` is raised with ``.outputs`` carrying the
         completed results. Admission order follows ``admit_policy``
-        (class docstring: strict-FIFO fairness by default)."""
+        (class docstring: strict-FIFO fairness by default).
+
+        This is the LEGACY all-or-nothing surface: invalid requests
+        raise, infeasible ones raise after the rest complete. The
+        fault-tolerant per-request surface — structured outcomes,
+        deadlines, cancellation, drain, device-failure recovery — is
+        :meth:`serve_detailed`; this wrapper runs the same engine."""
         self._validate(requests)
-        outputs: list[list[int] | None] = [None] * len(requests)
-        sampling = any(r.temperature > 0.0 for r in requests)
+        results = self._run(requests)
+        outputs = [r.tokens if r.status == OK else [] for r in results]
+        rejected = [i for i, r in enumerate(results)
+                    if r.status != OK and r.error is not None
+                    and "horizon" in r.error]
+        if rejected:
+            worst = max(self._rounded_need(requests[i].max_new)
+                        for i in rejected)
+            raise HorizonError(
+                f"per-row horizon exhausted for {len(rejected)} "
+                f"request(s): prompt_buf={self.Tb} + segment-rounded "
+                f"max_new (worst {worst}) exceeds t_max={self.t_max} — "
+                f"raise t_max or shrink max_new (completed outputs are "
+                f"on this error's .outputs)", outputs)
+        return outputs
+
+    def serve_detailed(self, requests: list[Request], *, drain=None,
+                       drain_deadline_s: float | None = None,
+                       chaos=None) -> list:
+        """Fault-tolerant serving: run every request through the pool
+        and return a :class:`serve_lifecycle.RequestResult` PER REQUEST
+        (in request order) — nothing raises away the call, and no
+        completed work is ever discarded.
+
+        Per-request lifecycle (``serve_lifecycle`` status vocabulary):
+        validation failures and horizon-infeasible budgets come back
+        ``failed`` with zero device work; ``Request.deadline_s`` expiry
+        returns the partial stream as ``timeout``; :meth:`cancel` (from
+        another thread or a chaos hook) returns ``cancelled``; bounded
+        admission (``max_pending``) rejects overload as ``shed`` at
+        submission.
+
+        ``drain`` — graceful shutdown: any object with a ``preempted``
+        attribute (``train/elastic.PreemptionGuard``, so SIGTERM drives
+        it). When it flips, admission stops (the still-queued requests
+        are ``shed``), in-flight rows run to completion within
+        ``drain_deadline_s`` (None = unbounded), and everything already
+        completed is returned ``ok``; rows still live at the drain
+        deadline return their partial streams ``cancelled``.
+
+        Device failures (a raised segment/harvest, or a harvest hung
+        past ``tick_timeout_s``) trigger SESSION RECONSTRUCTION
+        (``_reconstruct``): live rows are rebuilt token-exactly from
+        host-tracked state and decode resumes — bounded by
+        ``max_recoveries``, with a newest-admission eviction heuristic
+        when a fault survives reconstruction (a poison row re-poisons
+        every incarnation). ``chaos`` injects faults for drills
+        (:class:`serve_lifecycle.ChaosInjector`); production passes
+        None.
+        """
+        return self._run(requests, drain=drain,
+                         drain_deadline_s=drain_deadline_s, chaos=chaos)
+
+    def _run(self, requests: list[Request], *, drain=None,
+             drain_deadline_s: float | None = None, chaos=None) -> list:
+        """The scheduler engine behind :meth:`serve` and
+        :meth:`serve_detailed` — the overlapped dispatch/harvest loop
+        (module docstring) with the request lifecycle, drain protocol
+        and fault recovery threaded through its host-side decision
+        points."""
+        t0 = time.monotonic()
+        with self._cancel_mu:
+            self._cancelled.clear()
+        n = len(requests)
+        results: list[RequestResult | None] = [None] * n
+        ticks_charged = [0] * n
+        recs = [0] * n
+
+        def fin(i, status, tokens, error=None):
+            if results[i] is not None:
+                return                      # first terminal event wins
+            results[i] = RequestResult(
+                status=status, tokens=list(tokens), error=error,
+                ticks=ticks_charged[i],
+                latency_s=time.monotonic() - t0,
+                recoveries=recs[i])
+
+        # -- submission: validation failures are structured, not raised
+        valid = []
+        for i, r in enumerate(requests):
+            err = self._validate_one(r)
+            if err is not None:
+                fin(i, FAILED, [], err)
+            else:
+                valid.append(i)
+        sampling = any(requests[i].temperature > 0.0 for i in valid)
+        deadline_at: list[float | None] = [None] * n
+        for i in valid:
+            if requests[i].deadline_s is not None:
+                deadline_at[i] = t0 + requests[i].deadline_s
+
+        def horizon_msg(req):
+            return (f"per-row horizon exhausted: prompt_buf={self.Tb} + "
+                    f"segment-rounded max_new "
+                    f"({self._rounded_need(req.max_new)}) exceeds "
+                    f"t_max={self.t_max}")
+
         if self.admit_policy == "fifo":
             # per-request horizon gate (segment-rounded): a reject here
             # is PERMANENT — per-row positions admit at the same window
             # offset every time, so what can't fit now can never fit,
             # and FIFO refuses to leapfrog, so an infeasible head would
             # block the queue forever
-            rejected = [i for i, r in enumerate(requests)
-                        if not self._fits(r)]
-            rejected_set = set(rejected)
-            queue = [i for i in range(len(requests))
-                     if i not in rejected_set]
+            queue = []
+            for i in valid:
+                if self._fits(requests[i]):
+                    queue.append(i)
+                else:
+                    fin(i, FAILED, [], horizon_msg(requests[i]))
         else:
             # skip_fit: never-fitting requests are skipped in place at
             # admission time and reported at the end
-            queue = list(range(len(requests)))
+            queue = list(valid)
+
+        # -- bounded admission: overload rejects cheaply at submission
+        if self.max_pending is not None:
+            cap = self.B + self.max_pending
+            if len(queue) > cap:
+                for i in queue[cap:]:
+                    fin(i, SHED, [],
+                        f"shed: admission queue full ({len(queue)} "
+                        f"requests > slots ({self.B}) + max_pending "
+                        f"({self.max_pending}))")
+                queue = queue[:cap]
+
         table = [_Slot() for _ in range(self.B)]
+        admit_seq = [0]
+        draining = {"on": False, "deadline": None}
+        fault_state = {"recoveries": 0, "consecutive": 0}
+
+        def police():
+            """Host-known lifecycle transitions between device calls:
+            drain start (stop admission, shed the queue), cancellations
+            and deadline expiries (queued AND in-flight), and the drain
+            deadline. Pure host bookkeeping — no device work, so the
+            checks cost nothing on the hot path."""
+            now = time.monotonic()
+            if (drain is not None and getattr(drain, "preempted", False)
+                    and not draining["on"]):
+                draining["on"] = True
+                if drain_deadline_s is not None:
+                    draining["deadline"] = now + drain_deadline_s
+                for i in list(queue):
+                    fin(i, SHED, [], "shed: draining (admission stopped)")
+                queue.clear()
+            with self._cancel_mu:
+                cancelled = set(self._cancelled)
+            for i in list(queue):
+                if i in cancelled:
+                    queue.remove(i)
+                    fin(i, CANCELLED, [], "cancelled while queued")
+                elif deadline_at[i] is not None and now >= deadline_at[i]:
+                    queue.remove(i)
+                    fin(i, TIMEOUT, [],
+                        f"deadline_s={requests[i].deadline_s} expired "
+                        f"while queued")
+            for slot in table:
+                i = slot.req_index
+                if i < 0:
+                    continue
+                if i in cancelled:
+                    fin(i, CANCELLED, slot.out, "cancelled in flight")
+                    slot.free()
+                elif deadline_at[i] is not None and now >= deadline_at[i]:
+                    fin(i, TIMEOUT, slot.out,
+                        f"deadline_s={requests[i].deadline_s} expired "
+                        f"in flight")
+                    slot.free()
+            if (draining["on"] and draining["deadline"] is not None
+                    and now > draining["deadline"]):
+                for slot in table:
+                    if slot.req_index < 0:
+                        continue
+                    fin(slot.req_index, CANCELLED, slot.out,
+                        f"drain deadline ({drain_deadline_s}s) expired")
+                    slot.free()
 
         def pick_admissions(k_free: int) -> list[int]:
             take: list[int] = []
+            if draining["on"]:
+                return take                 # drain: admission stopped
             if self.admit_policy == "fifo":
                 while queue and len(take) < k_free:
                     take.append(queue.pop(0))
@@ -585,50 +871,11 @@ class ContinuousBatcher:
             take = pick_admissions(len(free))
             if not take:
                 return
-            K = len(take)
-            rows = free[:K]
-            # pad the wave to a multiple of the batch-axes product: pad
-            # rows are all-masked and scatter OUT OF BOUNDS (dropped) —
-            # see _admit_impl's partitioner note; off-mesh _dp == 1
-            Kp = -(-K // self._dp) * self._dp
-            prompt = np.zeros((Kp, self.Tb), np.int32)
-            pmask = np.zeros((Kp, self.Tb), np.float32)
-            lasts = np.zeros((K,), np.int32)
-            n_log = np.zeros((K,), np.int32)
-            caps = []
-            for j, ri in enumerate(take):
+            rows = free[:len(take)]
+            entries = []
+            for b, ri in zip(rows, take):
                 req = requests[ri]
-                # prefill all but the last prompt token; the next
-                # segment's first tick consumes that one (_admit_impl)
-                head, lasts[j] = req.tokens[:-1], req.tokens[-1]
-                n = len(head)
-                n_log[j] = n
-                if n:
-                    prompt[j, self.Tb - n:] = head
-                    pmask[j, self.Tb - n:] = 1.0
-                if self._block_takes_moe_capacity:
-                    caps.append(self._block.prefill_capacity(
-                        len(req.tokens)))
-            kw = {}
-            if caps:
-                kw["moe_capacity"] = max(caps)
-                if self._block_takes_moe_capacity_rows:
-                    kw["moe_capacity_rows"] = jnp.asarray(
-                        caps + [1] * (Kp - K), jnp.int32)
-            rows_j = jnp.asarray(rows, jnp.int32)
-            rows_pad = jnp.asarray(rows + [self.B] * (Kp - K), jnp.int32)
-            with self._mesh_ctx():
-                self._caches, self._slot_mask = self._admit_c(
-                    self.params, self._caches, self._slot_mask, rows_pad,
-                    jnp.asarray(prompt), jnp.asarray(pmask), **kw)
-                self._cur_tok = self._cur_tok.at[rows_j].set(
-                    jnp.asarray(lasts))
-                self._n_logical = self._n_logical.at[rows_j].set(
-                    jnp.asarray(n_log))
-            for j, ri in enumerate(take):
-                b = rows[j]
-                req = requests[ri]
-                self._row_pos[b] = self.Tb - 1   # the row's own horizon
+                entries.append((b, list(req.tokens)))
                 self._temp[b] = req.temperature
                 self._topk[b] = req.top_k or 0
                 self._topp[b] = req.top_p if req.top_p is not None else 2.0
@@ -638,8 +885,11 @@ class ContinuousBatcher:
                 slot.req_index = ri
                 slot.out = []
                 slot.remaining = req.max_new
+                slot.admit_seq = admit_seq[0]
+                admit_seq[0] += 1
+            self._prefill_wave(entries, self.Tb)
             self.stats["prefill_calls"] += 1
-            self.stats["prefill_rows"] += K
+            self.stats["prefill_rows"] += len(take)
 
         def dispatch_segment():
             """Dispatch ONE compiled segment (no fetch). Returns the
@@ -683,57 +933,253 @@ class ContinuousBatcher:
             self.stats["segments"] += 1
             for b, ri, take, _ in plan:
                 table[b].remaining -= take
+                ticks_charged[ri] += take
                 self.waste["planned_ticks"] += self.S
+            if chaos is not None and chaos.on_segment is not None:
+                # host observation hook: drills flip drain flags /
+                # cancel requests at a deterministic segment
+                chaos.on_segment(self.stats["segments"])
             return toks, plan
 
         def harvest(seg, overlapped: bool):
-            """THE one device->host fetch per segment. ``overlapped``
-            records whether the next segment was already dispatched
-            (the counter the bench smoke asserts)."""
+            """THE one device->host fetch per segment, under the tick
+            watchdog when configured. ``overlapped`` records whether
+            the next segment was already dispatched (the counter the
+            bench smoke asserts)."""
             toks, plan = seg
             self.stats["fetches"] += 1
             if overlapped:
                 self.stats["fetches_overlapped"] += 1
-            toks_h = np.asarray(toks)
+            if chaos is not None:
+                chaos.pre_fetch(self.stats["segments"],
+                                [ri for _, ri, _, _ in plan])
+
+            def fetch():
+                if chaos is not None:
+                    chaos.in_fetch(self.stats["segments"])
+                return np.asarray(toks)
+
+            if self.tick_timeout_s is not None:
+                toks_h = call_with_timeout(fetch, self.tick_timeout_s,
+                                           "serve tick harvest")
+            else:
+                toks_h = fetch()
             for b, ri, take, done_after in plan:
-                if outputs[ri] is not None:
-                    # the request finished (eos) in an earlier segment
-                    # while this one was already in flight — its ticks
-                    # are overlap tail waste, never tokens
+                if results[ri] is not None:
+                    # the request finished (eos) — or was cancelled /
+                    # timed out — in an earlier segment while this one
+                    # was already in flight: its ticks are overlap tail
+                    # waste, never tokens
                     continue
                 slot = table[b]
+                if slot.req_index != ri:
+                    continue   # row re-admitted after an early free
                 slot.out.extend(int(t) for t in toks_h[b, :take])
                 done = done_after
                 if self.eos_id is not None and self.eos_id in slot.out:
                     slot.out = slot.out[:slot.out.index(self.eos_id) + 1]
                     done = True
                 if done:
-                    outputs[ri] = slot.out
-                    slot.req_index = -1
-                    slot.out = []
-                    slot.remaining = 0
+                    fin(ri, OK, slot.out)
+                    slot.free()
 
-        # ---- the overlapped loop: dispatch N+1 BEFORE fetching N ----
+        def handle_fault(e: BaseException) -> bool:
+            """A device interaction failed (raised or hung). Recover by
+            session reconstruction, bounded by ``max_recoveries``; a
+            fault that SURVIVES reconstruction implicates a poison row,
+            and the newest admission is evicted before the next attempt
+            (the fault appeared after it joined the pool). Returns
+            False when the budget is exhausted — every remaining
+            request is failed with the underlying error instead of
+            wedging or crashing the process."""
+            self.stats["faults"] += 1
+            fault_state["consecutive"] += 1
+            t_fault = time.monotonic()
+            err = f"{type(e).__name__}: {e}"
+            if fault_state["recoveries"] >= self.max_recoveries:
+                msg = (f"device lost after {fault_state['recoveries']} "
+                       f"recovery attempt(s) ({err})")
+                for slot in table:
+                    if slot.req_index >= 0:
+                        fin(slot.req_index, FAILED, slot.out, msg)
+                        slot.free()
+                for i in list(queue):
+                    fin(i, FAILED, [], msg)
+                queue.clear()
+                return False
+            fault_state["recoveries"] += 1
+            if fault_state["consecutive"] >= 2:
+                live = [s for s in table if s.req_index >= 0]
+                if live:
+                    victim = max(live, key=lambda s: s.admit_seq)
+                    fin(victim.req_index, FAILED, victim.out,
+                        f"evicted as suspected poison row after "
+                        f"repeated faults ({err})")
+                    victim.free()
+            for slot in table:
+                if slot.req_index >= 0:
+                    recs[slot.req_index] += 1
+            self._reconstruct(table, requests, fin)
+            self.stats["reconstructions"] += 1
+            self.stats["recovery_s"] += time.monotonic() - t_fault
+            return True
+
+        # ---- the overlapped loop: dispatch N+1 BEFORE fetching N,
+        # every device interaction under the fault/recovery wrap ----
+        police()
         admit_wave()
         seg = dispatch_segment()
         while seg is not None:
-            nxt = dispatch_segment()       # overlap (None: nothing live)
-            harvest(seg, overlapped=nxt is not None)
-            admit_wave()                   # freed rows -> wave for N+2
+            nxt = None
+            try:
+                nxt = dispatch_segment()   # overlap (None: nothing live)
+                harvest(seg, overlapped=nxt is not None)
+                fault_state["consecutive"] = 0
+            except Exception as e:  # noqa: BLE001 — the fault path:
+                # chaos injection, the tick watchdog, or a real XLA
+                # runtime error. Degrade per request (reconstruct or
+                # fail the affected requests), never per process.
+                nxt = None
+                if not handle_fault(e):
+                    break
+            police()
+            admit_wave()                   # freed rows -> next wave
             if nxt is None:
                 nxt = dispatch_segment()   # revived by fresh admissions
+                                           # (or post-reconstruction)
             seg = nxt
 
-        results = [o if o is not None else [] for o in outputs]
-        if self.admit_policy != "fifo":
-            rejected = [i for i in queue if outputs[i] is None]
-        if rejected:
-            worst = max(self._rounded_need(requests[i].max_new)
-                        for i in rejected)
-            raise HorizonError(
-                f"per-row horizon exhausted for {len(rejected)} "
-                f"request(s): prompt_buf={self.Tb} + segment-rounded "
-                f"max_new (worst {worst}) exceeds t_max={self.t_max} — "
-                f"raise t_max or shrink max_new (completed outputs are "
-                f"on this error's .outputs)", results)
+        # whatever is still queued can never be admitted: skip_fit's
+        # never-fitting requests report their horizon error here
+        for i in list(queue):
+            if results[i] is None:
+                req = requests[i]
+                fin(i, FAILED, [],
+                    horizon_msg(req) if not self._fits(req) else
+                    "not served (scheduler exited with work queued)")
+        # slot-accounting invariant: every row must be free at exit —
+        # a leak means a cancelled/failed row kept its slot (tests and
+        # the chaos bench smoke assert last_slot_leaks == 0)
+        leaked = [s for s in table if s.req_index >= 0
+                  and results[s.req_index] is None]
+        self.last_slot_leaks = len(leaked)
+        for s in leaked:
+            fin(s.req_index, FAILED, s.out, "slot leak (scheduler bug)")
+            s.free()
+        for i in range(n):
+            if results[i] is None:
+                fin(i, FAILED, [], "not served (scheduler bug)")
         return results
+
+    # ---- fault recovery ---------------------------------------------------
+
+    def _prefill_wave(self, entries, window: int):
+        """ONE compiled multi-row prefill of ``entries`` ``(row,
+        known_tokens)`` at a static ``window`` width: every entry's
+        tokens-but-the-last land left-padded in its row's window, the
+        last becomes the row's current token, and the row rewinds to
+        ``window - 1`` (``_admit_impl``). Shared by admission waves
+        (``window == prompt_buf``) and reconstruction waves (``window``
+        sized to the grown prefix). Pure dispatch — no fetch."""
+        K = len(entries)
+        # pad the wave to a multiple of the batch-axes product: pad
+        # rows are all-masked and scatter OUT OF BOUNDS (dropped) —
+        # see _admit_impl's partitioner note; off-mesh _dp == 1
+        Kp = -(-K // self._dp) * self._dp
+        prompt = np.zeros((Kp, window), np.int32)
+        pmask = np.zeros((Kp, window), np.float32)
+        lasts = np.zeros((K,), np.int32)
+        n_log = np.zeros((K,), np.int32)
+        caps = []
+        rows = [b for b, _ in entries]
+        for j, (b, known) in enumerate(entries):
+            # prefill all but the last token; the next segment's first
+            # tick consumes that one (_admit_impl)
+            head, lasts[j] = known[:-1], known[-1]
+            nn = len(head)
+            n_log[j] = nn
+            if nn:
+                prompt[j, window - nn:] = head
+                pmask[j, window - nn:] = 1.0
+            if self._block_takes_moe_capacity:
+                caps.append(self._block.prefill_capacity(len(known)))
+        kw = {}
+        if caps:
+            kw["moe_capacity"] = max(caps)
+            if self._block_takes_moe_capacity_rows:
+                kw["moe_capacity_rows"] = jnp.asarray(
+                    caps + [1] * (Kp - K), jnp.int32)
+        rows_j = jnp.asarray(rows, jnp.int32)
+        rows_pad = jnp.asarray(rows + [self.B] * (Kp - K), jnp.int32)
+        with self._mesh_ctx():
+            self._caches, self._slot_mask = self._admit_c(
+                self.params, self._caches, self._slot_mask, rows_pad,
+                jnp.asarray(prompt), jnp.asarray(pmask), **kw)
+            self._cur_tok = self._cur_tok.at[rows_j].set(
+                jnp.asarray(lasts))
+            self._n_logical = self._n_logical.at[rows_j].set(
+                jnp.asarray(n_log))
+        for b, _ in entries:
+            self._row_pos[b] = window - 1    # the row's own horizon
+
+    def _reconstruct(self, table, requests, fin) -> None:
+        """Device-failure session reconstruction: rebuild every live
+        row's KV cache by re-prefilling ``prompt + generated-so-far``
+        from HOST-TRACKED state, then resume decode.
+
+        Soundness (DESIGN.md "Serving under failure"): the host knows
+        each live row's full token prefix exactly — the prompt plus
+        every HARVESTED token — and its true remaining budget.
+        Re-prefilling that prefix reproduces the lost cache's K/V (same
+        params; learned-position models embed logical indices, RoPE
+        scores depend only on within-row slot differences — both
+        preserved at any window offset, the same invariance batched
+        admission already relies on), ``n_logical`` restores to exactly
+        the pre-fault token count, and sampling keys depend only on
+        (seed, tokens-so-far) — so the resumed stream is
+        TOKEN-IDENTICAL to the uninterrupted one, greedy or sampled.
+        Tokens generated but never harvested died with the device
+        buffers and are simply recomputed.
+
+        Rows whose grown prefix no longer fits the per-row horizon
+        (window + segment-rounded remaining > t_max) cannot be rebuilt
+        and are finalised ``failed`` WITH their partial stream (size
+        t_max above the workload's minimum for fault-tolerance
+        headroom). Rows re-prefill in waves grouped by window width;
+        each distinct width compiles once, like any admission shape.
+        """
+        # fresh device state on the SAME compiled programs (reset()'s
+        # move): the old buffers are untrusted after a fault
+        self._caches = jax.tree.map(jnp.zeros_like, self._caches)
+        self._slot_mask = jnp.zeros_like(self._slot_mask)
+        self._cur_tok = jnp.zeros_like(self._cur_tok)
+        self._n_logical = jnp.zeros_like(self._n_logical)
+        self._row_pos = [self.Tb - 1] * self.B
+        waves: dict[int, list] = {}
+        for b, slot in enumerate(table):
+            if slot.req_index < 0:
+                continue
+            req = requests[slot.req_index]
+            known = list(req.tokens) + list(slot.out)
+            head = len(known) - 1
+            # reuse the admission window when the prefix still fits it
+            # (no new compile); else the next 8-aligned width
+            W = self.Tb if head <= self.Tb else -(-head // 8) * 8
+            remaining = req.max_new - len(slot.out)
+            if W + self._rounded_need(remaining) > self.t_max:
+                fin(slot.req_index, FAILED, slot.out,
+                    f"reconstruction needs window {W} + "
+                    f"{self._rounded_need(remaining)} decode slots > "
+                    f"t_max={self.t_max} (raise t_max for "
+                    f"fault-tolerance headroom)")
+                slot.free()
+                continue
+            waves.setdefault(W, []).append((b, slot, known, remaining))
+        for W, rows in sorted(waves.items()):
+            self._prefill_wave([(b, known) for b, _, known, _ in rows],
+                               W)
+            for b, slot, known, remaining in rows:
+                # host-known truth: the in-flight plan's budget
+                # decrement died with the old buffers
+                slot.remaining = remaining
+            self.stats["reconstruction_rows"] += len(rows)

@@ -31,6 +31,20 @@ device->host fetch is synchronous (the values must be this step's), the
 serialisation+write happens on a background thread, and the next save (or
 close) joins the previous write first.
 
+Integrity + retention (the silent-corruption story): every leaf (v1)
+and every shard entry (v2) is saved with a CRC-32 of its raw bytes in
+the manifest/part index, and restore VERIFIES what it reads — a
+bit-rotted or truncated-but-loadable file surfaces as a clear
+:class:`CheckpointCorruptError` naming the leaf, never as silently
+wrong weights. (CRC-32 is an integrity check against storage/transfer
+corruption, not a cryptographic signature.) ``keep_last=N`` retains the
+last N checkpoints — v1 single files rotate to ``{path}.prev-K``, v2
+directories keep N part GENERATIONS with a ``history`` list in the
+manifest — and :func:`restore_with_fallback` walks them newest-first,
+returning the newest checkpoint that verifies (the trainer's resume
+path, so one corrupted save costs ``checkpoint_every`` steps, not the
+run).
+
 No framework-specific pickle anywhere — everything is plain numpy + JSON.
 """
 
@@ -38,7 +52,9 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
+import zlib
 from typing import Any
 
 import jax
@@ -53,6 +69,34 @@ _FORMAT_VERSION = 1
 _SHARDED_VERSION = 2
 _SEP = "::"
 _MANIFEST = "manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed its integrity verification (CRC mismatch,
+    unreadable part, or torn container) — restore from a different
+    checkpoint (:func:`restore_with_fallback` automates that)."""
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _rotate(path: str, keep_last: int) -> None:
+    """Shift ``path`` -> ``path.prev-1`` -> ... -> ``path.prev-(N-1)``
+    (files or directories), dropping the oldest. Called before a v1
+    write so the last ``keep_last`` checkpoints stay restorable."""
+    if keep_last <= 1 or not os.path.exists(path):
+        return
+    oldest = f"{path}.prev-{keep_last - 1}"
+    if os.path.isdir(oldest):
+        shutil.rmtree(oldest, ignore_errors=True)
+    elif os.path.exists(oldest):
+        os.unlink(oldest)
+    for k in range(keep_last - 2, 0, -1):
+        src = f"{path}.prev-{k}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.prev-{k + 1}")
+    os.replace(path, f"{path}.prev-1")
 
 
 def _flatten(tree: PyTree) -> dict[str, np.ndarray]:
@@ -85,28 +129,35 @@ def _gather_host(tree: PyTree) -> PyTree:
     return jax.tree.map(fetch, tree)
 
 
-def _write_v1(path: str, host_tree, epoch: int, extra: dict | None) -> None:
+def _write_v1(path: str, host_tree, epoch: int, extra: dict | None,
+              keep_last: int = 1) -> None:
     """Serialise + atomically write an (already host-gathered) tree as the
     v1 single file. Shared by the sync and async paths so the schema cannot
-    drift between them."""
+    drift between them. The manifest records a CRC-32 per leaf (verified
+    on restore); ``keep_last > 1`` rotates the existing file to
+    ``.prev-1`` first so the previous good checkpoint survives."""
     flat = _flatten(host_tree)
     manifest = {"format": _FORMAT_VERSION, "epoch": epoch,
-                "extra": extra or {}}
+                "extra": extra or {},
+                "checksums": {k: _crc(v) for k, v in flat.items()}}
+    _rotate(path, keep_last)
     atomic_write(path,
                  lambda f: np.savez(f, __manifest__=json.dumps(manifest),
                                     **flat))
 
 
-def save(path: str, state, *, epoch: int = 0, extra: dict | None = None) -> None:
+def save(path: str, state, *, epoch: int = 0, extra: dict | None = None,
+         keep_last: int = 1) -> None:
     """Write ``state`` (a TrainState or any pytree) to ``path``.
 
     Coordinator-only write with atomic rename — the fix for the reference's
-    every-rank-writes race (``main.py:133``).
+    every-rank-writes race (``main.py:133``). ``keep_last``: retain that
+    many checkpoints (rotated ``.prev-K`` files; module docstring).
     """
     host_tree = _gather_host(state)   # collective: all processes participate
     if not is_coordinator():
         return
-    _write_v1(path, host_tree, epoch, extra)
+    _write_v1(path, host_tree, epoch, extra, keep_last)
 
 
 def load_manifest(path: str) -> dict:
@@ -154,7 +205,7 @@ def exists(path: str) -> bool:
 
 
 def save_sharded(path: str, state, *, epoch: int = 0,
-                 extra: dict | None = None) -> None:
+                 extra: dict | None = None, keep_last: int = 1) -> None:
     """Write ``state`` as a sharded checkpoint DIRECTORY at ``path``.
 
     Each process writes exactly the index spans it is the *lowest-indexed
@@ -171,6 +222,12 @@ def save_sharded(path: str, state, *, epoch: int = 0,
     derives G by reading the current manifest itself (only the coordinator
     ever writes it, and saves are collectively ordered), so no
     communication is needed.
+
+    Integrity + retention: every entry carries a CRC-32 (verified on
+    restore — module docstring); ``keep_last > 1`` retains the parts of
+    the last N generations, listed in the manifest's ``history`` so
+    :func:`restore_with_fallback` can reach them when the newest
+    generation is corrupt.
     """
     state = _unwrap_keys(state)
     pid = jax.process_index()
@@ -183,9 +240,11 @@ def save_sharded(path: str, state, *, epoch: int = 0,
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("dcp:ckpt-sharded-begin")
     try:
-        gen = int(load_manifest(path).get("generation", -1)) + 1
+        prev_manifest = load_manifest(path)
     except FileNotFoundError:
-        gen = 0
+        prev_manifest = None
+    gen = (0 if prev_manifest is None
+           else int(prev_manifest.get("generation", -1)) + 1)
     flat_entries: dict[str, np.ndarray] = {}
     part_index: list[dict] = []
     for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
@@ -199,7 +258,8 @@ def save_sharded(path: str, state, *, epoch: int = 0,
                 flat_entries[name] = arr
                 part_index.append({"key": key, "entry": name,
                                    "span": _span_of((), arr.shape),
-                                   "gshape": list(arr.shape)})
+                                   "gshape": list(arr.shape),
+                                   "crc32": _crc(arr)})
             continue
         shape = leaf.shape
         # lowest process index owning each distinct span writes it; every
@@ -218,10 +278,12 @@ def save_sharded(path: str, state, *, epoch: int = 0,
                 continue
             mine.discard(span)      # each distinct span once per process
             name = f"{key}@" + ",".join(f"{lo}:{hi}" for lo, hi in span)
-            flat_entries[name] = np.asarray(shard.data)
+            data = np.asarray(shard.data)
+            flat_entries[name] = data
             part_index.append({"key": key, "entry": name,
                                "span": [list(s) for s in span],
-                               "gshape": list(shape)})
+                               "gshape": list(shape),
+                               "crc32": _crc(data)})
     part_file = f"part-g{gen}-{pid:05d}.npz"
     atomic_write(os.path.join(path, part_file),
                  lambda f: np.savez(f, **flat_entries))
@@ -233,31 +295,63 @@ def save_sharded(path: str, state, *, epoch: int = 0,
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("dcp:ckpt-sharded-parts")
     if is_coordinator():
+        # retention history: this generation first, then the previous
+        # manifest's surviving history (legacy manifests without one
+        # contribute their own generation), truncated to keep_last
+        cur = {"generation": gen, "epoch": epoch, "extra": extra or {},
+               "num_parts": n_proc}
+        hist = [cur]
+        if prev_manifest is not None:
+            ph = prev_manifest.get("history")
+            if ph is None and prev_manifest.get("generation") is not None:
+                ph = [{"generation": int(prev_manifest["generation"]),
+                       "epoch": prev_manifest.get("epoch", 0),
+                       "extra": prev_manifest.get("extra", {}),
+                       "num_parts": prev_manifest.get("num_parts",
+                                                      n_proc)}]
+            hist += [h for h in (ph or [])
+                     if int(h["generation"]) != gen]
+        hist = hist[:max(1, int(keep_last))]
         manifest = {"format": _SHARDED_VERSION, "epoch": epoch,
                     "extra": extra or {},
-                    "generation": gen, "num_parts": n_proc}
+                    "generation": gen, "num_parts": n_proc,
+                    "history": hist}
         # COMMIT: atomic replace; the previous generation stays valid
         # until this succeeds
         atomic_write(os.path.join(path, _MANIFEST),
                      lambda f: json.dump(manifest, f), mode="w")
-        # best-effort prune of all other generations (now-dead data)
+        # best-effort prune of generations that fell out of retention
+        kept = {f"part-g{int(h['generation'])}-" for h in hist}
         for fn in os.listdir(path):
-            if fn.startswith("part-") and not fn.startswith(f"part-g{gen}-"):
+            if (fn.startswith("part-")
+                    and not any(fn.startswith(p) for p in kept)):
                 try:
                     os.unlink(os.path.join(path, fn))
                 except OSError:
                     pass
 
 
-def _sharded_entry_map(path: str) -> dict[str, list]:
-    """leaf key -> [(part_file, entry_name, span, gshape), ...].
+def _sharded_entry_map(path: str,
+                       generation: int | None = None) -> dict[str, list]:
+    """leaf key -> [(part_file, entry_name, span, gshape, crc), ...].
 
     Reads exactly the ``num_parts`` part manifests of the committed
     manifest's generation — parts from other (stale or half-written)
-    generations are never consulted."""
+    generations are never consulted. ``generation`` overrides which
+    RETAINED generation to read (the restore-fallback path; it must
+    appear in the manifest's ``history``)."""
     manifest = load_manifest(path)
     n = int(manifest.get("num_parts", 0))
     gen = manifest.get("generation")
+    if generation is not None:
+        hit = [h for h in manifest.get("history", [])
+               if int(h["generation"]) == int(generation)]
+        if not hit:
+            raise FileNotFoundError(
+                f"{path}: generation {generation} is not in the "
+                f"manifest's retention history")
+        gen = int(generation)
+        n = int(hit[0].get("num_parts", n))
     entries: dict[str, list] = {}
     for i in range(n):
         if gen is None:
@@ -274,16 +368,18 @@ def _sharded_entry_map(path: str) -> dict[str, list]:
             part = json.load(f)
         for e in part["entries"]:
             entries.setdefault(e["key"], []).append(
-                (part["file"], e["entry"], e["span"], e.get("gshape")))
+                (part["file"], e["entry"], e["span"], e.get("gshape"),
+                 e.get("crc32")))
     return entries
 
 
 def _assemble(path: str, pieces, span_lo, out):
     """Fill ``out`` (whose global position starts at ``span_lo``) from any
-    overlapping saved pieces. ``pieces``: [(file, entry, span, gshape), ...]."""
+    overlapping saved pieces, verifying each piece's CRC as it is read.
+    ``pieces``: [(file, entry, span, gshape, crc), ...]."""
     zcache: dict[str, Any] = {}
     try:
-        for fname, entry, span, _ in pieces:
+        for fname, entry, span, _, crc in pieces:
             # overlap of [span] with [span_lo, span_lo+out.shape)
             sel_src, sel_dst = [], []
             ok = True
@@ -298,9 +394,20 @@ def _assemble(path: str, pieces, span_lo, out):
             if not ok:
                 continue
             if fname not in zcache:
-                zcache[fname] = np.load(os.path.join(path, fname),
-                                        allow_pickle=False)
+                try:
+                    zcache[fname] = np.load(os.path.join(path, fname),
+                                            allow_pickle=False)
+                except Exception as e:  # torn zip container
+                    raise CheckpointCorruptError(
+                        f"{path}/{fname}: unreadable part file "
+                        f"({e})") from e
             data = zcache[fname][entry]
+            if crc is not None and _crc(data) != crc:
+                # verify-on-restore: bit rot / torn writes surface as a
+                # clear error, never as silently wrong weights
+                raise CheckpointCorruptError(
+                    f"{path}/{fname}: entry {entry!r} failed its CRC-32 "
+                    f"integrity check (corrupted checkpoint)")
             out[tuple(sel_dst)] = data[tuple(sel_src)]
     finally:
         for z in zcache.values():
@@ -308,8 +415,8 @@ def _assemble(path: str, pieces, span_lo, out):
 
 
 def _restore_sharded(path: str, template, shardings=None, *,
-                     _prefix: str = ""):
-    entries = _sharded_entry_map(path)
+                     _prefix: str = "", generation: int | None = None):
+    entries = _sharded_entry_map(path, generation)
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     flat_shardings = (jax.tree_util.tree_leaves(shardings)
                       if shardings is not None else [None] * len(paths))
@@ -377,8 +484,9 @@ class AsyncCheckpointer:
     from the writer surface on the next call.
     """
 
-    def __init__(self, sharded: bool = False):
+    def __init__(self, sharded: bool = False, keep_last: int = 1):
         self.sharded = sharded
+        self.keep_last = keep_last
         self._thread: threading.Thread | None = None
         self._error: BaseException | None = None
 
@@ -397,7 +505,8 @@ class AsyncCheckpointer:
             # sharded save is collective (barrier before the manifest
             # commit), so it runs inline; the per-process write itself is
             # already O(local shards)
-            save_sharded(path, state, epoch=epoch, extra=extra)
+            save_sharded(path, state, epoch=epoch, extra=extra,
+                         keep_last=self.keep_last)
             return
         host_tree = _gather_host(state)       # synchronous: step's values
         if not is_coordinator():
@@ -405,7 +514,9 @@ class AsyncCheckpointer:
 
         def write():
             try:
-                _write_v1(path, host_tree, epoch, extra)
+                # rotation happens on this thread too: the previous
+                # write was joined above, so nobody else touches path
+                _write_v1(path, host_tree, epoch, extra, self.keep_last)
             except BaseException as e:  # noqa: BLE001 — re-raised on join
                 self._error = e
 
@@ -436,7 +547,8 @@ def _is_key_leaf(leaf) -> bool:
     return dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key)
 
 
-def restore(path: str, template, shardings=None, *, _prefix: str = ""):
+def restore(path: str, template, shardings=None, *, _prefix: str = "",
+            generation: int | None = None):
     """Read a checkpoint back into ``template``'s pytree structure.
 
     ``template`` provides structure/shapes/dtypes — a freshly-initialised
@@ -449,11 +561,17 @@ def restore(path: str, template, shardings=None, *, _prefix: str = ""):
     restore under ANY mesh (elastic resize): the v1 file holds unsharded
     leaves; the v2 directory is reassembled span-by-span.
 
+    Everything read is verified against the saved CRC-32s (when the
+    checkpoint carries them — older checkpoints restore uncheck-ed);
+    corruption raises :class:`CheckpointCorruptError` naming the leaf.
+    ``generation`` picks an older RETAINED v2 generation (fallback path).
+
     ``_prefix`` offsets every template key into the stored tree (see
     :func:`restore_params`).
     """
     if os.path.isdir(path):
-        return _restore_sharded(path, template, shardings, _prefix=_prefix)
+        return _restore_sharded(path, template, shardings,
+                                _prefix=_prefix, generation=generation)
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     flat_shardings = (jax.tree_util.tree_leaves(shardings)
@@ -461,11 +579,68 @@ def restore(path: str, template, shardings=None, *, _prefix: str = ""):
     # NpzFile reads lazily per key: only the template's leaves are ever
     # decompressed, so a params-only restore (restore_params) never pays
     # for the optimizer-moment trees also stored in the file
-    with np.load(path, allow_pickle=False) as z:
+    try:
+        z = np.load(path, allow_pickle=False)
+    except Exception as e:   # torn zip container
+        raise CheckpointCorruptError(
+            f"{path}: unreadable checkpoint file ({e})") from e
+    with z:
         available = set(z.files)
+        try:
+            checksums = json.loads(str(z["__manifest__"])).get(
+                "checksums", {})
+        except Exception:
+            checksums = {}       # pre-integrity checkpoints
         _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
-                           _prefix)
+                           _prefix, checksums, path)
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def restore_with_fallback(path: str, template, shardings=None):
+    """Restore the newest checkpoint at ``path`` that VERIFIES, walking
+    the retention chain on corruption: the live v1 file then its
+    rotated ``.prev-K`` siblings, or the committed v2 generation then
+    the older generations in the manifest's ``history``. Returns
+    ``(state, manifest)`` — the manifest of whichever checkpoint
+    actually restored, so the caller resumes at ITS epoch/step. Raises
+    the LAST failure when every candidate is corrupt/unreadable.
+
+    This is the trainer's resume path: one bit-rotted save costs
+    ``checkpoint_every`` steps of progress, never the run.
+    """
+    candidates: list[tuple[str, int | None]] = [(path, None)]
+    if os.path.isdir(path):
+        try:
+            hist = load_manifest(path).get("history", [])[1:]
+        except Exception:
+            hist = []
+        candidates += [(path, int(h["generation"])) for h in hist]
+    else:
+        k = 1
+        while os.path.exists(f"{path}.prev-{k}"):
+            candidates.append((f"{path}.prev-{k}", None))
+            k += 1
+    last_err: Exception | None = None
+    for cand, gen in candidates:
+        try:
+            state = restore(cand, template, shardings, generation=gen)
+            manifest = load_manifest(cand)
+            if gen is not None:
+                hit = [h for h in manifest.get("history", [])
+                       if int(h["generation"]) == gen]
+                manifest = dict(manifest, **hit[0])
+            if last_err is not None:
+                import sys
+                print(f"[checkpoint] WARNING: newest checkpoint corrupt "
+                      f"({last_err}); restored fallback "
+                      f"{cand}" + (f" generation {gen}" if gen is not None
+                                   else ""),
+                      file=sys.stderr, flush=True)
+            return state, manifest
+        except (CheckpointCorruptError, OSError, KeyError, ValueError,
+                json.JSONDecodeError, EOFError) as e:
+            last_err = e
+    raise last_err if last_err is not None else FileNotFoundError(path)
 
 
 def _place(arr, shard):
@@ -483,13 +658,20 @@ def _place(arr, shard):
 
 
 def _restore_v1_leaves(z, available, paths, flat_shardings, leaves,
-                       _prefix):
+                       _prefix, checksums=None, src=""):
+    checksums = checksums or {}
     for (path_keys, leaf), shard in zip(paths, flat_shardings):
         key = _prefix + _SEP.join(
             str(getattr(k, "key", getattr(k, "idx", k))) for k in path_keys)
         if key not in available:
             raise KeyError(f"checkpoint missing leaf {key!r}")
         arr = z[key]
+        if key in checksums and _crc(arr) != checksums[key]:
+            # verify-on-restore (module docstring): corruption is a
+            # loud, named error — never silently wrong weights
+            raise CheckpointCorruptError(
+                f"{src}: leaf {key!r} failed its CRC-32 integrity "
+                f"check (corrupted checkpoint)")
         if _is_key_leaf(leaf):
             if shard is not None and not getattr(
                     shard, "is_fully_addressable", True):

@@ -55,8 +55,9 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
-from typing import Sequence
+from typing import Callable, Sequence
 
 from distributed_compute_pytorch_tpu.utils.fsio import atomic_write
 
@@ -293,6 +294,47 @@ class ClusterPreemption:
             s = self._claim(global_step + self.margin)
             self._stop_step = s
         return global_step >= s
+
+
+class CallTimeout(RuntimeError):
+    """``call_with_timeout`` exceeded its budget; the worker thread is
+    still blocked (and leaked — see the docstring)."""
+
+
+def call_with_timeout(fn: Callable[[], object], timeout: float,
+                      what: str = "call"):
+    """Run ``fn()`` on a worker thread; return its result, re-raise its
+    exception, or raise :class:`CallTimeout` after ``timeout`` seconds.
+
+    This is the in-process analogue of :func:`supervise`'s heartbeat
+    kill: a blocking device interaction (the serve loop's per-segment
+    token harvest — ``serve.ContinuousBatcher``'s tick watchdog — or
+    any other fetch that can wedge on a dead device) gets a bounded
+    wall-clock budget the caller can recover from. Python threads
+    cannot be killed, so on timeout the worker is LEAKED, still blocked
+    inside ``fn`` (daemon=True keeps it from blocking interpreter
+    exit); the caller must treat the underlying resource as lost —
+    which is exactly what serve's session reconstruction does with the
+    device buffers behind a timed-out fetch.
+    """
+    box: dict = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"dcp-timeout-{what}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise CallTimeout(f"{what} exceeded {timeout:.1f}s (hung device "
+                          f"interaction; worker thread leaked)")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
 
 
 def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,

@@ -63,11 +63,20 @@ class TrainState:
         return dataclasses.replace(self, **kw)
 
 
+def _grad_sumsq(tree):
+    """f32 sum of squares over every leaf — the global-gradient-norm
+    proxy the non-finite guard checks (NaN/Inf anywhere surfaces here;
+    the square can only ADD an overflow-to-Inf, never hide one)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
 def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                   strategy=None, donate: bool = True, compute_dtype=None,
                   augment=None, shard_update: bool | None = None,
                   quant_collectives: bool = False, accum_steps: int = 1,
-                  accum_dtype=None, accum_bucket_mb: float | None = None):
+                  accum_dtype=None, accum_bucket_mb: float | None = None,
+                  nonfinite_policy: str = "raise"):
     """Build ``(init_fn, train_step, eval_step)`` for ``model`` on ``mesh``.
 
     ``strategy`` decides parameter layout (default pure DP = replicated,
@@ -128,7 +137,30 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     Other strategies (FSDP/TP, or dp == 1) take an automatic-partitioner
     scan: same one-compiled-step / one-microbatch-activations contract,
     but the collective placement is the partitioner's.
+
+    ``nonfinite_policy`` — divergence containment. ``"raise"`` (default)
+    compiles nothing extra: the trainer aborts when a non-finite loss
+    shows up at its log-cadence fetch. ``"skip"`` compiles a guard INTO
+    the step: the update is applied only when the loss AND the global
+    gradient sum-of-squares are finite; otherwise params, opt_state and
+    model_state come back BIT-UNTOUCHED (a ``where`` select against the
+    incoming state — one bad batch cannot poison the trajectory), the
+    step counter still advances (the rng stream moves on, so the next
+    attempt draws fresh masks), and ``metrics["skipped"]`` reports 1.0
+    so the trainer can count and give up after K consecutive skips.
+    Incompatible with ``quant_collectives`` (the gradients live inside
+    its manual region with quantized wire values; guard there would
+    check the wrong numbers).
     """
+    if nonfinite_policy not in ("raise", "skip"):
+        raise ValueError(f"nonfinite_policy must be 'raise' or 'skip', "
+                         f"got {nonfinite_policy!r}")
+    skip_guard = nonfinite_policy == "skip"
+    if skip_guard and quant_collectives:
+        raise ValueError(
+            "nonfinite_policy 'skip' does not compose with "
+            "quant_collectives (gradients only exist quantized inside "
+            "the manual region); use nonfinite_policy 'raise'")
     strategy = strategy or DataParallel()
     fused_opt = hasattr(tx, "fused_apply")
     dp_ax = coll.dp_axes(mesh)
@@ -503,24 +535,33 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                 gsum, o, p, p_specs, buckets,
                 reduce_leaf=reduce_leaf, slice_leaf=slice_leaf,
                 gather_leaf=gather_leaf, update_fn=_local_update)
+            if skip_guard:
+                # per-rank LOCAL grad sum-of-squares, psum'd: non-finite
+                # on any rank => non-finite here (the reduced gradient
+                # inherits it), so the outer guard sees every divergence
+                gn2 = lax.psum(_grad_sumsq(gsum), dp_ax)
+                return new_p, new_o, new_ms, loss, gn2
             return new_p, new_o, new_ms, loss
 
         repl_p = jax.tree.map(lambda _: P(), params)
+        out_specs = (repl_p, o_specs, repl_ms, P())
+        if skip_guard:
+            out_specs = out_specs + (P(),)
         fn = shard_map(body, mesh=mesh,
                        in_specs=(repl_p, o_specs, repl_ms,
                                  P(ax_spec), P(ax_spec), P()),
-                       out_specs=(repl_p, o_specs, repl_ms, P()),
+                       out_specs=out_specs,
                        axis_names=set(dp_ax))
         # use_manual_axes: constrain() pins AND BatchNorm's sync-stat
         # pmean (models/layers.py) key off the declared manual dp axes
         with use_mesh(mesh), use_manual_axes(dp_ax), _layout_ctx():
-            new_p, new_o, new_ms, loss = fn(params, opt_state, mstate,
-                                            x, y, rng_data)
+            new_p, new_o, new_ms, loss, *rest = fn(params, opt_state,
+                                                   mstate, x, y, rng_data)
         if zero1:
             repl = NamedSharding(mesh, P())
             new_p = jax.tree.map(
                 lambda a: lax.with_sharding_constraint(a, repl), new_p)
-        return new_p, new_o, new_ms, loss
+        return new_p, new_o, new_ms, loss, (rest[0] if rest else None)
 
     def _accum_auto_step(state: TrainState, x, y, step_rng):
         """Step-level accumulation under the automatic partitioner
@@ -550,7 +591,27 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                            / accum_steps).astype(pl.dtype),
             gsum, state.params)
         new_p, new_o = _local_update(grads, state.opt_state, state.params)
-        return new_p, new_o, new_ms, jnp.mean(losses)
+        gn2 = _grad_sumsq(gsum) if skip_guard else None
+        return new_p, new_o, new_ms, jnp.mean(losses), gn2
+
+    def _guarded(state: TrainState, new_params, new_opt_state,
+                 new_mstate, loss, gn2, metrics):
+        """The non-finite skip: keep the UPDATED state only when loss
+        and the gradient sum-of-squares are finite; a bad batch leaves
+        params/opt_state/model_state bit-identical to the incoming
+        state (the scalar-pred ``where`` preserves shardings — ZeRO-1
+        opt shards select shard-locally). ``step`` always advances so
+        the rng stream (and the skip's visibility in metrics) moves."""
+        ok = jnp.isfinite(loss) & jnp.isfinite(gn2)
+        sel = lambda new, old: jax.tree.map(
+            lambda a, b: jnp.where(ok, a, b), new, old)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=sel(new_params, state.params),
+            model_state=sel(new_mstate, state.model_state),
+            opt_state=sel(new_opt_state, state.opt_state))
+        metrics["skipped"] = (~ok).astype(jnp.float32)
+        return new_state, metrics
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, x, y):
@@ -567,12 +628,16 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                     f"divides evenly")
             step_fn = (_accum_manual_step if accum_manual
                        else _accum_auto_step)
-            new_params, new_opt_state, new_mstate, loss = step_fn(
+            new_params, new_opt_state, new_mstate, loss, gn2 = step_fn(
                 state, x, y, step_rng)
+            metrics = {"loss": loss.astype(jnp.float32)}
+            if skip_guard:
+                return _guarded(state, new_params, new_opt_state,
+                                new_mstate, loss, gn2, metrics)
             new_state = state.replace(
                 step=state.step + 1, params=new_params,
                 model_state=new_mstate, opt_state=new_opt_state)
-            return new_state, {"loss": loss.astype(jnp.float32)}
+            return new_state, metrics
         x = _cast(x)
         if augment is not None:
             # dedicated key: the model's rng stream is unchanged whether or
@@ -616,6 +681,11 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
             else:
                 new_params, new_opt_state = _local_update(
                     grads, state.opt_state, state.params)
+            if skip_guard:
+                metrics = {"loss": loss.astype(jnp.float32)}
+                return _guarded(state, new_params, new_opt_state,
+                                new_mstate, loss, _grad_sumsq(grads),
+                                metrics)
         new_state = state.replace(
             step=state.step + 1, params=new_params,
             model_state=new_mstate, opt_state=new_opt_state)

@@ -39,6 +39,11 @@ from distributed_compute_pytorch_tpu.train.step import (
 from distributed_compute_pytorch_tpu.utils.logging import MetricLogger, log0
 from distributed_compute_pytorch_tpu.utils.timing import Timer, maybe_profile
 
+# nonfinite_policy=skip: abort after this many CONSECUTIVE skipped
+# updates — scattered skips are survivable (params stay untouched), an
+# unbroken run means the run has genuinely diverged
+NONFINITE_SKIP_LIMIT = 10
+
 
 class Trainer:
     """End-to-end training run from a :class:`Config`."""
@@ -143,7 +148,14 @@ class Trainer:
             augment=augment, shard_update=self._resolve_shard_update(),
             quant_collectives=config.quant_collectives,
             accum_steps=self.accum, accum_dtype=accum_dtype,
-            accum_bucket_mb=config.accum_bucket_mb)
+            accum_bucket_mb=config.accum_bucket_mb,
+            nonfinite_policy=config.nonfinite_policy)
+        # non-finite guard bookkeeping (train/step.py nonfinite_policy):
+        # per-step skip flags queue as DEVICE scalars and are only read
+        # at the log cadence — no per-step host sync on the hot path
+        self._skip_hist: list = []
+        self._skips_total = 0
+        self._skips_consec = 0
         # interleaved-pipeline runs keep the LIVE state's blocks in the
         # strided storage layout; checkpoints stay logical — these
         # converters sit at the save/restore boundaries (None otherwise)
@@ -162,12 +174,14 @@ class Trainer:
             log0(f"WARNING: {config.ckpt_path} exists but holds no "
                  f"committed checkpoint (interrupted save?); starting fresh")
         if config.resume and checkpoint.exists(config.ckpt_path):
-            manifest = checkpoint.load_manifest(config.ckpt_path)
             # restore each leaf straight into its strategy layout — the
-            # freshly-initialised state already carries the right shardings
-            shardings = jax.tree.map(lambda a: a.sharding, self.state)
-            self.state = checkpoint.restore(config.ckpt_path, self.state,
-                                            shardings=shardings)
+            # freshly-initialised state already carries the right
+            # shardings. Integrity: every read is CRC-verified, and a
+            # corrupted newest checkpoint falls back to the most recent
+            # retained good one (--keep_last), resuming at ITS manifest
+            self.state, manifest = checkpoint.restore_with_fallback(
+                config.ckpt_path, self.state,
+                shardings=jax.tree.map(lambda a: a.sharding, self.state))
             if self._layout is not None:
                 # checkpoint content is logical; the live state runs in
                 # interleaved storage
@@ -238,7 +252,8 @@ class Trainer:
                 from jax.experimental import multihost_utils
                 multihost_utils.sync_global_devices("dcp:preempt-reset")
         self.checkpointer = (checkpoint.AsyncCheckpointer(
-            sharded=config.ckpt_sharded) if config.async_checkpoint else None)
+            sharded=config.ckpt_sharded, keep_last=config.keep_last)
+            if config.async_checkpoint else None)
 
         self.logger = MetricLogger()
         log0(f"mesh: {dict(self.mesh.shape)} | dp world size: "
@@ -367,10 +382,10 @@ class Trainer:
                                    extra=extra)
         elif cfg.ckpt_sharded:
             checkpoint.save_sharded(cfg.ckpt_path, state, epoch=epoch,
-                                    extra=extra)
+                                    extra=extra, keep_last=cfg.keep_last)
         else:
             checkpoint.save(cfg.ckpt_path, state, epoch=epoch,
-                            extra=extra)
+                            extra=extra, keep_last=cfg.keep_last)
 
     def _finish(self) -> None:
         """Flush any in-flight async checkpoint write, then the logger."""
@@ -394,11 +409,15 @@ class Trainer:
                                    start=skip):
             self._maybe_inject_fault(epoch * steps + b)
             self.state, metrics = self.train_step(self.state, x, y)
+            if "skipped" in metrics:
+                # device scalar, queued unread: fetched at log cadence
+                self._skip_hist.append(metrics["skipped"])
             if b % cfg.log_every == 0:
                 # read the device scalar only at the logging cadence
                 # (reference cadence, main.py:64)
-                self.logger.train_line(epoch, b, steps,
-                                       float(metrics["loss"]))
+                loss = float(metrics["loss"])
+                self._poll_nonfinite(loss, epoch, b)
+                self.logger.train_line(epoch, b, steps, loss)
                 if self.heartbeat is not None:
                     self.heartbeat.beat(epoch, epoch * steps + b)
             if self._should_preempt(guard, epoch * steps + b):
@@ -415,9 +434,51 @@ class Trainer:
         # which would overstate samples/s (bench.py uses the same fence)
         if metrics is not None:
             np.asarray(metrics["loss"])
+            # drain the skip flags queued since the last log line, so an
+            # epoch can't end with unexamined non-finite skips
+            self._poll_nonfinite(float(metrics["loss"]), epoch, steps - 1)
         secs = timer.elapsed()
         # each update consumes the full effective batch (micro x accum)
         return (steps - skip) * cfg.batch_size * self.accum / secs
+
+    def _poll_nonfinite(self, loss: float, epoch: int, b: int) -> None:
+        """Log-cadence divergence containment (``--nonfinite_policy``).
+
+        ``skip``: drain the per-step skip flags the compiled guard
+        produced (their values settled long ago — fetching here stalls
+        nothing), log the running count, and give up after
+        :data:`NONFINITE_SKIP_LIMIT` CONSECUTIVE skips — params are
+        bit-untouched throughout, so delayed detection is harmless.
+        ``raise``: a non-finite loss at the cadence fetch aborts (the
+        params are already poisoned; fail fast and let the supervisor
+        restart from the last checkpoint)."""
+        import math
+        if self.config.nonfinite_policy == "skip":
+            new_skips = 0
+            for s in self._skip_hist:
+                if float(s) > 0.0:
+                    self._skips_total += 1
+                    self._skips_consec += 1
+                    new_skips += 1
+                else:
+                    self._skips_consec = 0
+            self._skip_hist.clear()
+            if new_skips:
+                log0(f"nonfinite_policy=skip: skipped {new_skips} "
+                     f"non-finite update(s) near epoch {epoch} step {b} "
+                     f"(total {self._skips_total}, consecutive "
+                     f"{self._skips_consec})")
+            if self._skips_consec >= NONFINITE_SKIP_LIMIT:
+                raise RuntimeError(
+                    f"{self._skips_consec} consecutive non-finite "
+                    f"updates skipped (epoch {epoch} step {b}): the run "
+                    f"has diverged — params are still the last finite "
+                    f"state; lower the lr or clip gradients")
+        elif not math.isfinite(loss):
+            raise RuntimeError(
+                f"non-finite loss {loss} at epoch {epoch} step {b} "
+                f"(nonfinite_policy=raise); use --nonfinite_policy skip "
+                f"to drop bad updates instead of aborting")
 
     def _should_preempt(self, guard, global_step: int) -> bool:
         """Per-step preemption poll. Single-host: the local signal flag.

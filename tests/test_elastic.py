@@ -24,13 +24,28 @@ import pytest
 from distributed_compute_pytorch_tpu.core.config import Config
 from distributed_compute_pytorch_tpu.data.datasets import synthetic_images
 from distributed_compute_pytorch_tpu.train.elastic import (
-    EXIT_PREEMPTED, Heartbeat, PreemptionGuard, supervise)
+    EXIT_PREEMPTED, CallTimeout, Heartbeat, PreemptionGuard,
+    call_with_timeout, supervise)
 from distributed_compute_pytorch_tpu.train.trainer import Trainer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 # ---------------------------------------------------------------- primitives
+
+
+def test_call_with_timeout_result_error_and_hang():
+    """The in-process watchdog (serve's tick harvest rides on this):
+    results and exceptions pass through; a blocked call raises
+    CallTimeout within the budget instead of hanging the caller."""
+    assert call_with_timeout(lambda: 41 + 1, 5.0) == 42
+    with pytest.raises(KeyError, match="boom"):
+        call_with_timeout(lambda: (_ for _ in ()).throw(KeyError("boom")),
+                          5.0)
+    t0 = time.monotonic()
+    with pytest.raises(CallTimeout, match="hung"):
+        call_with_timeout(lambda: time.sleep(3.0), 0.2, "drill")
+    assert time.monotonic() - t0 < 2.0
 
 
 def test_heartbeat_roundtrip(tmp_path):

@@ -1,0 +1,430 @@
+"""Serve fault tolerance (serve.serve_detailed + serve_lifecycle): every
+recovery path exercised through injected chaos — device-fault session
+reconstruction must be TOKEN-IDENTICAL to the uninterrupted stream
+(greedy and sampled rows; the host knows each row's full prefix and
+sampling is keyed on (seed, tokens-so-far), so replay is exact),
+deadlines/cancellation/shed/drain must degrade PER REQUEST with partial
+results and zero slot leaks, and the legacy ``serve()`` contract stays
+bit-compatible (tests/test_serve.py keeps pinning that side)."""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.infer import generate
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.models.llama import (
+    LlamaConfig, LlamaLM)
+from distributed_compute_pytorch_tpu.models.moe import (
+    MoETransformerConfig, MoETransformerLM)
+from distributed_compute_pytorch_tpu.serve import ContinuousBatcher, Request
+from distributed_compute_pytorch_tpu.serve_lifecycle import (
+    ChaosInjector, RequestResult)
+
+
+@pytest.fixture(scope="module")
+def gpt2_cb():
+    """One batcher shared by most drills (reset() between tests): the
+    compiled admit/segment programs are per-instance closures, so
+    reusing the instance keeps this module's tier-1 compile bill at one
+    program set."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    cb = ContinuousBatcher(model, params, slots=2, t_max=64,
+                           prompt_buf=10, segment=3)
+    return model, params, cb
+
+
+def _requests(rng, n, min_new=5, max_new=9):
+    reqs = []
+    for _ in range(n):
+        ln = int(rng.integers(2, 10))
+        reqs.append(Request(
+            tokens=[int(t) for t in rng.integers(0, 256, size=ln)],
+            max_new=int(rng.integers(min_new, max_new + 1))))
+    return reqs
+
+
+def _standalone(model, params, req):
+    solo = generate(model, params, jnp.asarray([req.tokens], jnp.int32),
+                    req.max_new)
+    return [int(t) for t in np.asarray(solo)[0, len(req.tokens):]]
+
+
+def _assert_clean(cb):
+    """The slot-accounting invariant every drill must leave behind."""
+    assert cb.last_slot_leaks == 0
+
+
+def test_chaos_fault_reconstruction_parity_gpt2(gpt2_cb):
+    """The flagship drill: a device fault mid-stream (injected raise at
+    the harvest — where a real dead chip surfaces) destroys the live KV
+    caches; reconstruction re-prefills prompt + generated-so-far from
+    host state and the resumed streams must equal the uninterrupted
+    standalone run token for token — for GREEDY and SAMPLED rows side
+    by side (sampling keys depend only on (seed, tokens-so-far))."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    rng = np.random.default_rng(71)
+    reqs = _requests(rng, 6, min_new=6, max_new=12)
+    for i in (1, 3):                       # sampled rows amid greedy ones
+        reqs[i].temperature = 0.9
+        reqs[i].seed = 500 + i
+    sampled_clean = None
+    res = cb.serve_detailed(
+        [dataclasses.replace(r) for r in reqs],
+        chaos=ChaosInjector(fault_at_segment=2, fault_mode="raise"))
+    assert cb.stats["faults"] == 1
+    assert cb.stats["reconstructions"] == 1
+    assert cb.stats["reconstruction_rows"] >= 1
+    _assert_clean(cb)
+    # greedy rows: parity against standalone generation
+    for i, (req, r) in enumerate(zip(reqs, res)):
+        assert isinstance(r, RequestResult) and r.status == "ok", (i, r)
+        assert r.ticks >= req.max_new
+        if req.temperature == 0.0:
+            assert r.tokens == _standalone(model, params, req), i
+    # sampled rows: parity against a CLEAN (fault-free) serve of the
+    # same stream — reconstruction must not perturb the key schedule
+    cb.reset()
+    sampled_clean = cb.serve([dataclasses.replace(r) for r in reqs])
+    assert [r.tokens for r in res] == sampled_clean
+
+
+def test_chaos_fault_reconstruction_parity_llama():
+    """Second model family (RoPE/GQA: reconstruction re-ropes the
+    re-prefilled prefix at new absolute slots — scores depend only on
+    within-row slot differences, so parity must survive the window
+    shift), greedy + sampled."""
+    model = LlamaLM(dataclasses.replace(LlamaConfig.tiny(),
+                                        max_seq_len=128))
+    params, _ = model.init(jax.random.key(1))
+    rng = np.random.default_rng(73)
+    reqs = _requests(rng, 5, min_new=6, max_new=10)
+    reqs[2].temperature = 0.8
+    reqs[2].seed = 42
+    cb = ContinuousBatcher(model, params, slots=2, t_max=64,
+                           prompt_buf=10, segment=3)
+    res = cb.serve_detailed(
+        [dataclasses.replace(r) for r in reqs],
+        chaos=ChaosInjector(fault_at_segment=2, fault_mode="raise"))
+    assert cb.stats["reconstructions"] == 1
+    _assert_clean(cb)
+    assert all(r.status == "ok" for r in res)
+    for req, r in zip(reqs, res):
+        if req.temperature == 0.0:
+            assert r.tokens == _standalone(model, params, req)
+    cb.reset()
+    clean = cb.serve([dataclasses.replace(r) for r in reqs])
+    assert [r.tokens for r in res] == clean
+
+
+@pytest.mark.slow
+def test_chaos_fault_reconstruction_parity_moe():
+    """MoE routing through reconstruction: the re-prefill derives its
+    expert-queue capacity from the REAL (grown) prefix length, so
+    routing equals the uninterrupted run's (generous eval capacity: the
+    documented no-drop precondition)."""
+    cfg = dataclasses.replace(MoETransformerConfig.tiny(), max_seq_len=128,
+                              eval_capacity_factor=4.0)
+    model = MoETransformerLM(cfg)
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(79)
+    reqs = _requests(rng, 4, min_new=5, max_new=8)
+    cb = ContinuousBatcher(model, params, slots=2, t_max=64,
+                           prompt_buf=10, segment=3)
+    res = cb.serve_detailed(
+        [dataclasses.replace(r) for r in reqs],
+        chaos=ChaosInjector(fault_at_segment=2, fault_mode="raise"))
+    assert cb.stats["reconstructions"] == 1
+    for req, r in zip(reqs, res):
+        assert r.status == "ok"
+        assert r.tokens == _standalone(model, params, req)
+
+
+def test_watchdog_hang_recovers_and_slow_tick_does_not(gpt2_cb):
+    """The tick watchdog: a harvest hung past tick_timeout_s raises
+    TickTimeout and reconstruction resumes token-exactly; a merely SLOW
+    tick under the budget must NOT trigger recovery (no false
+    positives)."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    rng = np.random.default_rng(83)
+    reqs = _requests(rng, 4, min_new=5, max_new=8)
+    cb.tick_timeout_s = 0.4
+    try:
+        res = cb.serve_detailed(
+            [dataclasses.replace(r) for r in reqs],
+            chaos=ChaosInjector(fault_at_segment=2, fault_mode="hang",
+                                hang_s=1.5))
+        assert cb.stats["faults"] == 1
+        assert cb.stats["reconstructions"] == 1
+        _assert_clean(cb)
+        for req, r in zip(reqs, res):
+            assert r.status == "ok"
+            assert r.tokens == _standalone(model, params, req)
+        # slow tick (well under the budget): no fault, same outputs
+        cb.reset()
+        res2 = cb.serve_detailed(
+            [dataclasses.replace(r) for r in reqs],
+            chaos=ChaosInjector(fault_at_segment=2, fault_mode="slow",
+                                slow_s=0.05))
+        assert cb.stats["faults"] == 0
+        assert cb.stats["reconstructions"] == 0
+        assert [r.tokens for r in res2] == [r.tokens for r in res]
+    finally:
+        cb.tick_timeout_s = None
+
+
+def test_deadline_expiry_queued_and_in_flight(gpt2_cb):
+    """Per-request wall-clock deadlines: an expired queued request times
+    out with no device work; an in-flight one is cut at a segment
+    boundary with its PARTIAL stream; neighbours are untouched."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    res = cb.serve_detailed([
+        Request([1, 2, 3], 6),
+        Request([4, 5], 6, deadline_s=1e-9),       # dead on arrival
+    ])
+    assert res[0].status == "ok" and len(res[0].tokens) == 6
+    assert res[1].status == "timeout" and res[1].tokens == []
+    assert res[1].ticks == 0 and res[1].error and "expired" in res[1].error
+    _assert_clean(cb)
+    # in-flight expiry: a long request with a deadline that can only
+    # fire mid-stream (the on_segment hook burns wall clock so even a
+    # fast machine crosses it after the first segments)
+    cb.reset()
+    chaos = ChaosInjector(on_segment=lambda s: time.sleep(0.06))
+    res = cb.serve_detailed(
+        [Request([1, 2, 3], 40, deadline_s=0.1), Request([7, 8], 5)],
+        chaos=chaos)
+    assert res[0].status == "timeout", res[0]
+    assert 0 < len(res[0].tokens) < 40          # partial stream kept
+    assert res[1].status == "ok"
+    _assert_clean(cb)
+
+
+def test_cancellation_returns_partial_and_frees_slot(gpt2_cb):
+    """cancel() mid-stream: the cancelled request returns its partial
+    tokens, its slot is reused by a queued request (no leak), and the
+    surviving requests keep standalone parity."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    chaos = ChaosInjector(
+        on_segment=lambda s: cb.cancel(0) if s == 2 else None)
+    # slots=2: requests 0,1 admitted; 2 queued behind the pool
+    reqs = [Request([1, 2, 3], 36), Request([4, 5, 6], 6),
+            Request([7, 8, 9], 6)]
+    res = cb.serve_detailed([dataclasses.replace(r) for r in reqs],
+                            chaos=chaos)
+    assert res[0].status == "cancelled"
+    assert 0 < len(res[0].tokens) < 36          # partial stream kept
+    for req, r in zip(reqs[1:], res[1:]):
+        assert r.status == "ok"
+        assert r.tokens == _standalone(model, params, req)
+    _assert_clean(cb)
+    # the pool is reusable after cancellations: a fresh serve works
+    again = cb.serve_detailed([Request([1, 2, 3], 4)])
+    assert again[0].status == "ok" and len(again[0].tokens) == 4
+
+
+def test_shed_under_overload_and_structured_validation(gpt2_cb):
+    """Bounded admission: beyond slots + max_pending, requests shed at
+    submission with zero device work; submission-time validation
+    failures (over-long prompt, bad budget, out-of-vocab ids) are
+    structured per-request failures that never occupy a slot — and the
+    feasible stream is served normally around all of them."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    cb.max_pending = 1
+    try:
+        good = Request([1, 2, 3], 4)
+        res = cb.serve_detailed([
+            Request(list(range(11)), 4),          # prompt > prompt_buf
+            Request([1, 2], 0),                   # bad budget
+            Request([1, 999999], 4),              # out-of-vocab id
+            dataclasses.replace(good),
+            Request([4, 5], 4),
+            Request([6, 7], 4),
+            Request([8, 9], 4),                   # beyond 2 slots + 1
+        ])
+        statuses = [r.status for r in res]
+        assert statuses[:3] == ["failed"] * 3, statuses
+        assert "prompt_buf" in res[0].error
+        assert "max_new" in res[1].error
+        assert "vocab" in res[2].error
+        assert all(r.ticks == 0 for r in res[:3])
+        assert statuses[3:6] == ["ok"] * 3, statuses
+        assert statuses[6] == "shed" and "max_pending" in res[6].error
+        assert res[3].tokens == _standalone(model, params, good)
+        _assert_clean(cb)
+    finally:
+        cb.max_pending = None
+
+
+def test_drain_returns_completed_within_deadline(gpt2_cb):
+    """Graceful drain: when the drain flag flips (SIGTERM in prod — the
+    PreemptionGuard contract), admission stops (queued requests shed),
+    in-flight rows finish inside the drain deadline, and every
+    already-completed output comes back ok and standalone-exact."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+
+    class Guard:
+        preempted = False
+
+    g = Guard()
+    chaos = ChaosInjector(
+        on_segment=lambda s: setattr(g, "preempted", g.preempted or s >= 3))
+    reqs = _requests(np.random.default_rng(89), 8, min_new=5, max_new=7)
+    t0 = time.monotonic()
+    res = cb.serve_detailed([dataclasses.replace(r) for r in reqs],
+                            drain=g, drain_deadline_s=30.0, chaos=chaos)
+    wall = time.monotonic() - t0
+    statuses = [r.status for r in res]
+    assert "shed" in statuses                   # admission stopped
+    assert all(s in ("ok", "shed") for s in statuses), statuses
+    for req, r in zip(reqs, res):
+        if r.status == "ok":
+            assert r.tokens == _standalone(model, params, req)
+        else:
+            assert "drain" in r.error
+    assert wall < 30.0                          # well inside the deadline
+    _assert_clean(cb)
+    # a DRAIN DEADLINE that cannot cover the in-flight work: the row is
+    # cut with its partial stream instead of overstaying
+    cb.reset()
+    g2 = Guard()
+    chaos2 = ChaosInjector(on_segment=lambda s: (
+        setattr(g2, "preempted", True), time.sleep(0.05)))
+    res2 = cb.serve_detailed([Request([1, 2, 3], 40)], drain=g2,
+                             drain_deadline_s=0.01, chaos=chaos2)
+    assert res2[0].status == "cancelled"
+    assert "drain deadline" in res2[0].error
+    assert 0 < len(res2[0].tokens) < 40
+    _assert_clean(cb)
+
+
+def test_poison_row_eviction_isolates_the_fault(gpt2_cb):
+    """A poison request re-faults every reconstruction; the scheduler's
+    newest-admission eviction isolates it after the second consecutive
+    fault, and every OTHER request completes exactly."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    reqs = ([Request([1, 2, 3], 18)]
+            + [Request([4 + i, 5, 6], 5) for i in range(4)])
+    res = cb.serve_detailed(
+        [dataclasses.replace(r) for r in reqs],
+        chaos=ChaosInjector(fault_mode="poison", poison_request=1,
+                            fault_count=10))
+    assert res[1].status == "failed" and "poison" in res[1].error
+    for i, (req, r) in enumerate(zip(reqs, res)):
+        if i == 1:
+            continue
+        assert r.status == "ok", (i, r)
+        assert r.tokens == _standalone(model, params, req), i
+    assert cb.stats["faults"] >= 2
+    assert cb.stats["reconstructions"] >= 1
+    _assert_clean(cb)
+
+
+def test_recovery_budget_exhausted_fails_cleanly(gpt2_cb):
+    """A persistent fault (every harvest raises, forever): the engine
+    burns its max_recoveries budget and FAILS the remaining requests
+    with the underlying error — no hang, no escaped exception, no
+    leaked slot."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    old = cb.max_recoveries
+    cb.max_recoveries = 1
+    try:
+        res = cb.serve_detailed(
+            [Request([1, 2, 3], 8), Request([4, 5], 8),
+             Request([6, 7], 8)],
+            chaos=ChaosInjector(fault_at_segment=1, fault_mode="raise",
+                                fault_count=99))
+        assert all(r.status == "failed" for r in res), res
+        assert all("device lost" in r.error for r in res)
+        _assert_clean(cb)
+        # the batcher itself survives: reset + a clean serve still works
+        cb.reset()
+        ok = cb.serve_detailed([Request([1, 2, 3], 4)])
+        assert ok[0].status == "ok"
+    finally:
+        cb.max_recoveries = old
+
+
+def test_legacy_serve_unchanged_by_lifecycle_machinery(gpt2_cb):
+    """serve() (the legacy all-or-nothing surface) must behave exactly
+    as before on a batcher that HAS lifecycle knobs available: raises
+    on invalid input, returns plain token lists, leaks nothing."""
+    model, params, cb = gpt2_cb
+    cb.reset()
+    with pytest.raises(ValueError, match="prompt_buf"):
+        cb.serve([Request(list(range(11)), 2)])
+    outs = cb.serve([Request([1, 2, 3], 4), Request([5, 6], 5)])
+    assert [len(o) for o in outs] == [4, 5]
+    _assert_clean(cb)
+
+
+@pytest.mark.slow
+def test_cli_serve_sigterm_drain_subprocess(tmp_path):
+    """The end-to-end SIGTERM drill: dcp-serve in a real subprocess,
+    SIGTERM mid-run — the process must finish in-flight work, print one
+    structured line per request (completed ones 'ok'), and exit 75
+    (EXIT_PREEMPTED), all inside the drain deadline."""
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+    from distributed_compute_pytorch_tpu.train.elastic import EXIT_PREEMPTED
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    ck = str(tmp_path / "ck.npz")
+    data = synthetic_lm(64, seq_len=128, vocab=256, seed=9)
+    cfg = Config(batch_size=32, lr=1e-3, epochs=1, mesh="data=1",
+                 model="gpt2", model_preset="tiny",
+                 dataset="synthetic-lm", optimizer="adamw", ckpt_path=ck,
+                 force_cpu=True)
+    Trainer(cfg, train_data=data, eval_data=data).fit()
+
+    reqfile = tmp_path / "reqs.txt"
+    # ~384k decode ticks through 2 slots (the tiny model serves a
+    # measured ~100k ticks in ~25s on this box): around a minute of
+    # serving if left alone, so the signal reliably lands mid-stream
+    # (and if it lands during startup instead, the drain sheds
+    # everything — equally valid, still exit 75)
+    n_req = 4000
+    reqfile.write_text("".join(
+        json.dumps({"tokens": [(i % 200) + 1, 2, 3], "max_new": 96})
+        + "\n" for i in range(n_req)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_compute_pytorch_tpu.cli_serve",
+         "--ckpt_path", ck, "--model", "gpt2", "--model_preset", "tiny",
+         "--max_seq_len", "128", "--requests", str(reqfile),
+         "--slots", "2", "--segment", "4", "--drain_deadline", "60"],
+        stdout=subprocess.PIPE, env=env, text=True)
+    # the drain guard arms at CLI entry (before the heavy imports), so
+    # this lands anywhere in startup/compile/serving — every case must
+    # drain to exit 75 with one structured line per request
+    time.sleep(8)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=300)
+    assert proc.returncode == EXIT_PREEMPTED, (proc.returncode, out)
+    lines = [json.loads(ln) for ln in out.strip().splitlines()]
+    assert len(lines) == n_req
+    statuses = {ln["status"] for ln in lines}
+    assert statuses <= {"ok", "shed", "cancelled"}, statuses
+    assert "shed" in statuses          # the queue was cut by the drain
+    for ln in lines:
+        if ln["status"] == "ok":
+            assert len(ln["new"]) == 96

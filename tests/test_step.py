@@ -114,6 +114,64 @@ def test_eval_metrics_are_global_sums(devices8):
     np.testing.assert_allclose(float(m["loss_sum"]), ref, rtol=1e-4)
 
 
+def test_nonfinite_policy_skip_semantics(devices8):
+    """The non-finite guard (nonfinite_policy='skip'): a NaN batch's
+    update is SKIPPED with params and opt_state bit-untouched (the
+    trajectory can't be poisoned by one bad batch) while metrics report
+    the skip; a finite batch through the same compiled step updates
+    normally with skipped == 0. The step counter advances either way
+    (fresh rng stream for the retry)."""
+    mesh = make_mesh("data=8", devices=devices8)
+    model = ConvNet()
+    tx = adadelta_steplr(lr=0.5, gamma=0.7, steps_per_epoch=10)
+    init_fn, train_step, _ = make_step_fns(model, tx, mesh,
+                                           nonfinite_policy="skip")
+    state = init_fn(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (8, 28, 28, 1))
+    y = jnp.zeros((8,), jnp.int32)
+
+    state, m = train_step(state, x, y)
+    assert float(m["skipped"]) == 0.0
+    p1 = jax.device_get(state.params)
+    o1 = jax.device_get(state.opt_state)
+    step1 = int(state.step)
+
+    state, m = train_step(state, x.at[0, 0, 0, 0].set(jnp.nan), y)
+    assert float(m["skipped"]) == 1.0
+    assert not np.isfinite(float(m["loss"]))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(state.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(o1),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(state.opt_state))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(state.step) == step1 + 1       # schedule/rng still move
+
+    # the run recovers: the same finite batch trains again afterwards
+    state, m = train_step(state, x, y)
+    assert float(m["skipped"]) == 0.0
+    changed = any(
+        (np.asarray(a) != np.asarray(b)).any()
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(state.params))))
+    assert changed
+
+
+def test_nonfinite_policy_validation():
+    """Bad policy strings and the quant_collectives incompatibility are
+    rejected at build time, not at step time."""
+    import pytest
+
+    mesh = make_mesh("data=8")
+    model = ConvNet()
+    tx = adadelta_steplr(0.1, 0.7, 10)
+    with pytest.raises(ValueError, match="nonfinite_policy"):
+        make_step_fns(model, tx, mesh, nonfinite_policy="ignore")
+
+
 def test_lr_schedule_steps_per_epoch():
     """StepLR parity: lr decays by gamma once per epoch (main.py:125,131)."""
     from distributed_compute_pytorch_tpu.train.optim import steplr
