@@ -24,7 +24,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 test bench bench-smoke serve-chaos-smoke
+.PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -53,11 +53,21 @@ bench:
 #   (all requests ok), the recovered streams are token-identical to a
 #   fault-free run, goodput under the fault stays > 0, and no cache
 #   row leaks its slot; records recovery time
+# - serve-prefix: the paged-KV prefix cache on a Zipf-shared prompt
+#   stream (hot system prompts, cold tails); fails unless the hit rate
+#   is positive, cache-on output is token-identical to cache-off,
+#   prefill_tokens_saved > 0, COW runs, no block/slot leaks, and the
+#   warm-cache admission TTFT proxy is not degraded; records
+#   prefill-bytes-saved
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --zero1-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-smoke
 	JAX_PLATFORMS=cpu python bench.py --grad-accum-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
 
 serve-chaos-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
+
+serve-prefix-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke

@@ -16,6 +16,11 @@ line, either
     {"tokens": [12,7,90], "max_new": 16}   # per-request budget
 
 or, with ``--tokenizer``, ``{"text": "..."}`` lines / raw text lines.
+``--prefix_cache`` turns on radix prefix caching over the paged KV
+block pool (``--kv_block_tokens``): requests sharing a prompt prefix
+attach to already-prefilled blocks copy-on-write instead of re-running
+prefill — token-identical outputs, and every output line reports how
+many prompt tokens were served from cache (``"cached_prefix"``).
 JSON requests may also carry per-request sampling settings
 (``"temperature"``, ``"top_k"``, ``"top_p"``, ``"seed"``), overriding
 the CLI defaults — requests with different settings decode side by
@@ -161,6 +166,20 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=None,
                    help="base sampling seed; request i uses seed+i "
                         "(default: i) so the whole file is deterministic")
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="radix prefix caching over the paged KV pool: "
+                        "requests sharing a prompt prefix (system "
+                        "prompts) attach to already-prefilled blocks "
+                        "copy-on-write instead of re-running prefill; "
+                        "outputs stay token-identical. Each output "
+                        "line reports its 'cached_prefix' length. Not "
+                        "supported for --model moe (routing is "
+                        "group-dependent)")
+    p.add_argument("--kv_block_tokens", type=int, default=None,
+                   help="logical tokens per KV-pool block (default: "
+                        "the Pallas cache window; rounded up to a "
+                        "window multiple). Smaller blocks share "
+                        "prefixes at a finer grain")
     p.add_argument("--admit_policy", default="fifo",
                    choices=("fifo", "skip_fit"),
                    help="admission order: strict FIFO (fairness: no "
@@ -273,7 +292,9 @@ def main(argv=None) -> int:
                            admit_policy=args.admit_policy,
                            max_pending=args.max_pending,
                            tick_timeout_s=args.tick_timeout,
-                           max_recoveries=args.max_recoveries)
+                           max_recoveries=args.max_recoveries,
+                           kv_block_tokens=args.kv_block_tokens,
+                           prefix_cache=args.prefix_cache)
 
     def req_seed(i, r):
         if r["seed"] is not None:
@@ -301,7 +322,8 @@ def main(argv=None) -> int:
         guard.__exit__()
     for r, res in zip(reqs, results):
         rec = {"prompt": r["tokens"], "new": res.tokens,
-               "status": res.status}
+               "status": res.status,
+               "cached_prefix": res.cached_prefix_tokens}
         if res.error is not None:
             rec["error"] = res.error
         if tok is not None:

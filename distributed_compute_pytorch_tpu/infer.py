@@ -59,12 +59,42 @@ from distributed_compute_pytorch_tpu.core.mesh import constrain, use_mesh
 # group with no resharding against the params.
 _CACHE_SPEC = P(None, ("data", "fsdp"), "tensor", None, None)
 
+# Paged-pool layout (the serving block pool, ``serve.ContinuousBatcher``):
+# per-layer ``{"kv": [2(k/v), P, Hk, bt, hd]}`` — P physical blocks of bt
+# slots each, addressed through a per-row block table [B, nb]. Axis 1 is
+# BLOCKS (not rows), sharded over the batch axes so the pool's HBM
+# footprint splits across the data group like the dense rows did; kv
+# heads stay on ``tensor``. A row's blocks may live on any device — the
+# per-tick gather's output is constrained back to the row-sharded
+# ``_CACHE_SPEC`` layout, so XLA inserts whatever collective the two
+# layouts imply (the arXiv:2112.01075 portable-redistribution move; the
+# same spec tuple serves both layouts since only the axis MEANING
+# changes).
+_POOL_SPEC = _CACHE_SPEC
+
 
 def _constrain_cache(cache):
     # same layout pin for every cache leaf (the int8 form adds a paired
-    # per-row scale array [2, B, Hk, T, 1] — sharded exactly like kv)
-    return {name: constrain(leaf, _CACHE_SPEC)
+    # per-row scale array [2, B, Hk, T, 1] — sharded exactly like kv);
+    # the paged form's host-built block table rides along unpinned (a
+    # tiny int32 [B, nb] the partitioner replicates)
+    return {name: (leaf if name == "table"
+                   else constrain(leaf, _CACHE_SPEC))
             for name, leaf in cache.items()}
+
+
+def paged_cache_view(cache):
+    """Materialise the logical dense view of a PAGED cache dict
+    (``{"kv": pool, "table": [B, nb], ...}``) — the per-row
+    ``[2, B, Hk, nb*bt, hd]`` layout every dense cache consumer
+    understands. Debug/inspection helper (checkpointing a paged session
+    into the dense layout); the decode hot path gathers inside
+    ``ops/attention.py::cache_write_and_attend`` instead."""
+    from distributed_compute_pytorch_tpu.ops.attention import (
+        gather_kv_blocks)
+    table = cache["table"]
+    return {name: gather_kv_blocks(leaf, table)
+            for name, leaf in cache.items() if name != "table"}
 
 
 def _per_layer(stacked, i: int):

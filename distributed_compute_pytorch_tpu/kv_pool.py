@@ -1,0 +1,313 @@
+"""Host-side bookkeeping for the paged KV cache: a refcounted block
+pool and a radix prefix cache over prompt tokens.
+
+The serving cache (``serve.ContinuousBatcher``) is a pool of fixed-size
+K/V blocks — ``[2, num_blocks, hk, block_tokens, hd]`` per layer — and
+each request maps its LOGICAL slot range onto physical blocks through a
+per-row block table. Everything the device touches stays static-shaped;
+the allocation problem lives entirely here, on the host, between device
+dispatches:
+
+- :class:`BlockPool` — refcounted allocation over the physical blocks.
+  Block 0 is the TRASH block: parked/freed rows keep writing garbage
+  K/V every segment (the compiled segment ticks all rows), so their
+  tables are pointed at trash where the garbage can never corrupt a
+  live or cached block. Refcounts make sharing sound: a block attached
+  by k rows plus the radix tree is freed only when the last reference
+  drops, and :meth:`leak_check` is the block-level extension of the
+  serve scheduler's slot-leak discipline (``last_slot_leaks`` ->
+  ``last_block_leaks``).
+- :class:`RadixCache` — a path-compressed radix tree over prompt-HEAD
+  token sequences (the last prompt token is never prefilled — it is the
+  row's first decode input — so it is never cached either). A new
+  request's longest cached prefix resolves to block ids it can ATTACH
+  to instead of re-running prefill: full blocks are shared read-only
+  (refcount++); a prefix ending mid-block is attached copy-on-write —
+  the partial block is device-copied and the copy's tail overwritten by
+  the attacher's own suffix (the divergent write never touches the
+  shared original). Eviction is LRU over tree entries and frees only
+  blocks whose refcount drops to zero — blocks still attached to live
+  rows survive their tree entry.
+
+Soundness of sharing (the argument DESIGN.md carries in long form): a
+cached block holds post-projection (post-rope) K/V for tokens at
+ABSOLUTE logical positions ``[j*bt, (j+1)*bt)``, and serve's admission
+lays every prompt out from logical slot 0 — so two requests sharing a
+token prefix produce BIT-IDENTICAL K/V for the shared span (same
+params, same tokens, same positions), and attaching is exact, not
+approximate. Blocks reachable from the tree are immutable over their
+recorded valid span: the owning row only ever appends at slots >= its
+own prefill extent, which later matchers never read (a match length is
+capped by the entry's recorded token count).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class PoolExhausted(RuntimeError):
+    """No free block satisfies an allocation — with the serve layer's
+    sizing (``pool_blocks >= slots * blocks_per_row + 1``) this means a
+    refcount leak, not genuine pressure, so it is raised loudly."""
+
+
+class BlockPool:
+    """Refcounted allocator over ``num_blocks`` physical cache blocks.
+
+    Block ``TRASH`` (0) is reserved at construction with a permanent
+    reference: parked rows write into it every segment, so it can never
+    be handed out. ``high_water`` tracks peak occupancy (the
+    ``block_pool_occupancy`` stat)."""
+
+    TRASH = 0
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (one is the reserved "
+                             f"trash block), got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.ref = [0] * num_blocks
+        self.ref[self.TRASH] = 1          # pinned forever
+        # LIFO free list: recently-freed blocks are re-used first, which
+        # keeps the working set small and makes leak repros deterministic
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self.high_water = 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` fresh blocks (refcount 1 each)."""
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} blocks, {len(self._free)} free of "
+                f"{self.num_blocks} (refcount leak or undersized pool)")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            assert self.ref[b] == 0, (b, self.ref[b])
+            self.ref[b] = 1
+        self.high_water = max(self.high_water, self.allocated)
+        return out
+
+    def acquire(self, block: int) -> None:
+        """Add a reference to an already-live block (prefix attach /
+        tree insertion)."""
+        assert self.ref[block] > 0, f"acquire on dead block {block}"
+        self.ref[block] += 1
+
+    def release(self, blocks) -> None:
+        """Drop one reference per block; refcount-0 blocks return to the
+        free list."""
+        for b in blocks:
+            assert b != self.TRASH and self.ref[b] > 0, (b, self.ref[b])
+            self.ref[b] -= 1
+            if self.ref[b] == 0:
+                self._free.append(b)
+
+    def reset(self) -> None:
+        """Free everything (device-failure reconstruction zeroes the
+        device pool; the host accounting restarts with it)."""
+        self.ref = [0] * self.num_blocks
+        self.ref[self.TRASH] = 1
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+
+    def leak_check(self, expected: dict[int, int]) -> int:
+        """Blocks whose refcount differs from ``expected`` (block ->
+        count; the radix tree's held references) once every row has
+        released its blocks — the serve scheduler's ``last_block_leaks``
+        invariant. 0 means every reference is accounted for."""
+        leaks = 0
+        for b in range(self.num_blocks):
+            want = 1 if b == self.TRASH else expected.get(b, 0)
+            if self.ref[b] != want:
+                leaks += 1
+        return leaks
+
+
+@dataclass
+class _Node:
+    """One radix-tree node: ``key`` is the token run on the edge from
+    its parent; an ``entry`` marks a full inserted head sequence ending
+    here (its block list covers the whole root->here path)."""
+
+    key: tuple = ()
+    children: dict = field(default_factory=dict)
+    entry: "_Entry | None" = None
+
+
+@dataclass
+class _Entry:
+    blocks: list          # block ids covering ceil(n_tokens / bt)
+    n_tokens: int         # valid prefix length the blocks hold
+    last_used: int = 0
+
+
+class RadixCache:
+    """Longest-prefix lookup from prompt-head tokens to prefilled block
+    ids, with LRU eviction.
+
+    ``match`` returns ``(m, blocks)``: the longest cached prefix length
+    and the blocks covering it (``ceil(m / block_tokens)`` ids; the last
+    one is PARTIAL when ``m % block_tokens != 0`` and must be attached
+    copy-on-write). ``insert`` records a freshly prefilled head and
+    acquires one pool reference per block, so cached blocks survive
+    their producing request. ``evict_for`` drops least-recently-used
+    entries until the pool can satisfy an allocation — only blocks whose
+    refcount reaches zero are actually freed, so entries sharing blocks
+    with live rows cost nothing to evict but also free nothing."""
+
+    def __init__(self, pool: BlockPool, block_tokens: int):
+        self.pool = pool
+        self.bt = block_tokens
+        self.root = _Node()
+        self._clock = 0
+        self.entries: list[_Entry] = []
+
+    # ---- stats / accounting ------------------------------------------------
+
+    def held(self) -> dict[int, int]:
+        """block id -> number of tree references (for leak accounting)."""
+        out: dict[int, int] = {}
+        for e in self.entries:
+            for b in e.blocks:
+                out[b] = out.get(b, 0) + 1
+        return out
+
+    def clear(self) -> None:
+        """Drop every entry (device-failure reconstruction: the pool
+        content is untrusted, so the cache over it is too)."""
+        for e in self.entries:
+            self.pool.release(e.blocks)
+        self.entries = []
+        self.root = _Node()
+
+    # ---- lookup ------------------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, tokens) -> tuple[int, list[int]]:
+        """Longest cached prefix of ``tokens``: ``(m, blocks)`` with
+        ``blocks`` covering ``ceil(m / bt)``; ``(0, [])`` on a miss.
+        Refcounts are NOT acquired here — the caller attaches explicitly
+        (it may cap ``m`` further, e.g. to its own head length)."""
+        tokens = tuple(tokens)
+        node, matched = self.root, 0
+        while matched < len(tokens):
+            child = node.children.get(tokens[matched])
+            if child is None:
+                break
+            key = child.key
+            common = 0
+            limit = min(len(key), len(tokens) - matched)
+            while common < limit and key[common] == tokens[matched + common]:
+                common += 1
+            matched += common
+            if common < len(key):
+                node = child          # ended mid-edge: subtree extends us
+                break
+            node = child
+        entry = self._any_entry(node)
+        if entry is None or matched == 0:
+            return 0, []
+        m = min(matched, entry.n_tokens)
+        if m == 0:
+            return 0, []
+        entry.last_used = self._tick()
+        return m, entry.blocks[:-(-m // self.bt)]
+
+    def _any_entry(self, node: _Node) -> "_Entry | None":
+        """Any entry in ``node``'s subtree — every path through ``node``
+        shares the matched prefix, so any of them can supply its
+        blocks."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.entry is not None:
+                return n.entry
+            stack.extend(n.children.values())
+        return None
+
+    # ---- insertion ---------------------------------------------------------
+
+    def insert(self, tokens, blocks: list[int]) -> bool:
+        """Record a freshly prefilled head; acquires one pool reference
+        per block. Returns False (and acquires nothing) when the exact
+        sequence is already cached — the existing entry just refreshes
+        its LRU stamp."""
+        tokens = tuple(tokens)
+        assert len(blocks) == -(-len(tokens) // self.bt), (
+            len(tokens), len(blocks), self.bt)
+        node, i = self.root, 0
+        while i < len(tokens):
+            child = node.children.get(tokens[i])
+            if child is None:
+                new = _Node(key=tokens[i:])
+                node.children[tokens[i]] = new
+                node = new
+                i = len(tokens)
+                break
+            key = child.key
+            common = 0
+            limit = min(len(key), len(tokens) - i)
+            while common < limit and key[common] == tokens[i + common]:
+                common += 1
+            if common < len(key):
+                # split the edge at the divergence (or early-end) point
+                mid = _Node(key=key[:common])
+                child.key = key[common:]
+                mid.children[child.key[0]] = child
+                node.children[tokens[i]] = mid
+                node = mid
+                i += common
+                continue
+            node = child
+            i += common
+        if node.entry is not None:
+            node.entry.last_used = self._tick()
+            return False
+        node.entry = _Entry(blocks=list(blocks), n_tokens=len(tokens),
+                            last_used=self._tick())
+        for b in blocks:
+            self.pool.acquire(b)
+        self.entries.append(node.entry)
+        return True
+
+    # ---- eviction ----------------------------------------------------------
+
+    def evict_for(self, need_free: int) -> int:
+        """Drop LRU entries until the pool has ``need_free`` free blocks
+        (or the tree is empty). Returns the number of entries evicted.
+        Only refcount-0 blocks actually free — a block shared with a
+        live row stays resident."""
+        evicted = 0
+        while self.pool.free_count < need_free and self.entries:
+            victim = min(self.entries, key=lambda e: e.last_used)
+            self.entries.remove(victim)
+            self._detach(victim)
+            self.pool.release(victim.blocks)
+            evicted += 1
+        return evicted
+
+    def _detach(self, entry: _Entry) -> None:
+        """Unlink ``entry`` from its node (prune childless entry-less
+        leaves so dead paths do not accumulate)."""
+        self._prune(self.root, entry)
+
+    def _prune(self, node: _Node, entry: _Entry) -> bool:
+        """DFS removal; returns True when ``node`` itself became
+        removable (no entry, no children)."""
+        if node.entry is entry:
+            node.entry = None
+        for first, child in list(node.children.items()):
+            if self._prune(child, entry):
+                del node.children[first]
+        return (node is not self.root and node.entry is None
+                and not node.children)

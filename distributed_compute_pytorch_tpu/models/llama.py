@@ -159,13 +159,21 @@ class LlamaBlock:
         return constrain_activations(x, manual_axes)
 
     def apply(self, params, x, *, rng=None, train: bool = False,
-              kv_mask=None, manual_axes=(), kv_sink=None, positions=None):
+              kv_mask=None, manual_axes=(), kv_sink=None, positions=None,
+              kv_prefix=None):
         """``positions`` overrides the rope positions (default
         ``arange(T)``, seq-ring-offset under a manual region): the
-        serving layer's slot-offset admission prefill (``serve.py``)
-        ropes prompt keys at their ABSOLUTE cache slots so later decode
-        queries — roped at their own slots — see the right position
-        differences."""
+        serving layer's admission prefill (``serve.py``) ropes prompt
+        keys at their ABSOLUTE cache slots so later decode queries —
+        roped at their own slots — see the right position differences.
+
+        ``kv_prefix``: optional ``(k0, v0, prefix_mask)`` cached-prefix
+        K/V prepended before attention (kv-head width, post-rope at
+        their own absolute slots) — the chunked suffix-prefill path of
+        the serving prefix cache; see
+        ``transformer.attention_sublayer``. The suffix ``positions``
+        must then start at the prefix length so query/key rope slots
+        stay globally consistent."""
         del rng, train    # the Llama recipe has no dropout
         c = self.config
         d, hd = c.d_model, c.head_dim
@@ -178,8 +186,12 @@ class LlamaBlock:
         q, k, v = self._qkv(params, h, pos)
         if kv_sink is not None:
             # prefill capture: post-rope, kv-head width — exactly what the
-            # decode cache stores
+            # decode cache stores (suffix-only under a kv_prefix)
             kv_sink.append((k, v))
+        if kv_prefix is not None:
+            from distributed_compute_pytorch_tpu.models.transformer import (
+                _concat_kv_prefix)
+            k, v, kv_mask = _concat_kv_prefix(kv_prefix, k, v, kv_mask)
         # GQA K/V stay at num_kv_heads width: the dispatcher repeats heads
         # only for the kernels that need it (ring paths rotate the narrow
         # K/V — see dispatch_attention)

@@ -485,18 +485,24 @@ class MoEBlock:
 
     def apply(self, p, x, *, rng=None, train: bool = False, kv_mask=None,
               manual_axes=(), kv_sink=None, moe_capacity=None,
-              moe_capacity_rows=None):
+              moe_capacity_rows=None, kv_prefix=None):
         from distributed_compute_pytorch_tpu.models.transformer import (
             attention_sublayer)
         c = self.config
         d = c.d_model
         h = L.LayerNorm(d).apply(p["ln1"], x)
         # shared attention half (flash kernel on TPU, ring attention on a
-        # seq>1 mesh — same dispatch as the dense blocks)
+        # seq>1 mesh — same dispatch as the dense blocks). kv_prefix is
+        # accepted for the shared prefill contract but the serving layer
+        # refuses prefix caching for MoE models: routing is
+        # group-dependent, so a suffix-only routing group cannot
+        # reproduce the standalone full-prompt queues when capacity
+        # binds (the attention math itself would be exact).
         a = attention_sublayer(p, h, num_heads=c.num_heads, causal=True,
                                dropout_rate=c.dropout_rate, rng=rng,
                                train=train, manual_axes=manual_axes,
-                               kv_mask=kv_mask, kv_sink=kv_sink)
+                               kv_mask=kv_mask, kv_sink=kv_sink,
+                               kv_prefix=kv_prefix)
         x = x + a
         h = L.LayerNorm(d).apply(p["ln2"], x)
         if kv_sink is not None:

@@ -68,7 +68,8 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
                        seq_axis: str = "seq", attn_impl: str = "auto",
                        dropout_rate: float = 0.0, rng=None,
                        train: bool = False, kv_mask=None,
-                       manual_axes: tuple = (), kv_sink: list | None = None):
+                       manual_axes: tuple = (), kv_sink: list | None = None,
+                       kv_prefix=None):
     """Fused-QKV multi-head attention + output projection + dropout.
 
     The shared attention half of every transformer variant (dense blocks
@@ -85,6 +86,19 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     directly via ``ring_attention_manual`` — a nested shard_map cannot sit
     inside a manual region.
 
+    ``kv_prefix``: optional ``(k0, v0, prefix_mask)`` — ALREADY-COMPUTED
+    K/V (kv-head width ``[B, Hk, Lp, hd]``, ``prefix_mask [B, Lp]``,
+    1 = valid) prepended to this window's keys/values before attention.
+    This is the chunked suffix-prefill path (the serving layer's prefix
+    cache, ``serve.ContinuousBatcher``): the window holds only a
+    prompt's UNSHARED suffix, its queries attend the cached prefix plus
+    the causal window, and only the suffix K/V are captured into
+    ``kv_sink``. The bottom-right-aligned causal mask (``ops/attention.
+    dot_product_attention``: ``row >= col - (kv_len - q_len)``) gives
+    exactly "all prefix + window up to self" with no extra mask code.
+    Unsupported under a seq/ring mesh axis (the serve layer rejects
+    those meshes already).
+
     ``params``: ``{"qkv": Dense(d, 3d), "attn_out": Dense(d, d)}`` trees.
     """
     from jax.ad_checkpoint import checkpoint_name
@@ -99,6 +113,9 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     v = A.split_heads(v, num_heads)
     if kv_sink is not None:
         kv_sink.append((k, v))   # prefill capture for KV-cache decoding
+                                 # (suffix-only when a prefix is attached)
+    if kv_prefix is not None:
+        k, v, kv_mask = _concat_kv_prefix(kv_prefix, k, v, kv_mask)
     o = dispatch_attention(q, k, v, causal=causal, seq_axis=seq_axis,
                            attn_impl=attn_impl, kv_mask=kv_mask,
                            manual_axes=manual_axes)
@@ -106,6 +123,20 @@ def attention_sublayer(params, x, *, num_heads: int, causal: bool = False,
     o = A.merge_heads(o)
     o = L.Dense(d, d).apply(params["attn_out"], o)
     return L.dropout(o, dropout_rate, rng, train)
+
+
+def _concat_kv_prefix(kv_prefix, k, v, kv_mask):
+    """Prepend cached-prefix K/V (and validity) to a window's keys:
+    shared by every family's ``apply`` (dense/MoE here, Llama in
+    ``models/llama.py``). The window mask defaults to all-real when the
+    caller passed none."""
+    pk, pv, pmask = kv_prefix
+    k2 = jnp.concatenate([pk.astype(k.dtype), k], axis=2)
+    v2 = jnp.concatenate([pv.astype(v.dtype), v], axis=2)
+    if kv_mask is None:
+        kv_mask = jnp.ones((k.shape[0], k.shape[2]), jnp.float32)
+    mask2 = jnp.concatenate([pmask.astype(kv_mask.dtype), kv_mask], axis=1)
+    return k2, v2, mask2
 
 
 def attention_decode_tick(params, x, cache, pos, *, num_heads: int,
@@ -169,12 +200,13 @@ class TransformerBlock:
         }
 
     def _attn(self, params, x, rng, train, kv_mask=None, manual_axes=(),
-              kv_sink=None):
+              kv_sink=None, kv_prefix=None):
         return attention_sublayer(
             params, x, num_heads=self.num_heads, causal=self.causal,
             seq_axis=self.seq_axis, attn_impl=self.attn_impl,
             dropout_rate=self.dropout_rate, rng=rng, train=train,
-            kv_mask=kv_mask, manual_axes=manual_axes, kv_sink=kv_sink)
+            kv_mask=kv_mask, manual_axes=manual_axes, kv_sink=kv_sink,
+            kv_prefix=kv_prefix)
 
     def _mlp(self, params, x, rng, train):
         from jax.ad_checkpoint import checkpoint_name
@@ -196,7 +228,7 @@ class TransformerBlock:
         return constrain_activations(x, manual_axes, self.seq_axis)
 
     def apply(self, params, x, *, rng=None, train: bool = False,
-              kv_mask=None, manual_axes=(), kv_sink=None):
+              kv_mask=None, manual_axes=(), kv_sink=None, kv_prefix=None):
         r1 = r2 = None
         if train and rng is not None:
             r1, r2 = jax.random.split(rng)
@@ -205,7 +237,8 @@ class TransformerBlock:
         x = self._ssa(x, manual_axes)
         if self.pre_ln:
             x = x + self._attn(params, ln1.apply(params["ln1"], x), r1,
-                               train, kv_mask, manual_axes, kv_sink)
+                               train, kv_mask, manual_axes, kv_sink,
+                               kv_prefix)
             x = self._ssa(x, manual_axes)
             x = x + self._mlp(params, ln2.apply(params["ln2"], x), r2, train)
         else:  # post-LN (BERT)

@@ -248,6 +248,62 @@ def cached_attention_q8(q, cache, pos, *, scale: float | None = None,
     return out.reshape(B, H, q_len, hd) if grouped else out
 
 
+def gather_kv_blocks(pool_leaf, table):
+    """Materialise the LOGICAL per-row cache view of a paged pool leaf:
+    ``pool_leaf [s, P, hk, bt, hd]`` gathered through ``table [B, nb]``
+    -> ``[s, B, hk, nb * bt, hd]`` — row ``b``'s logical slot ``t`` is
+    ``pool_leaf[:, table[b, t // bt], :, t % bt]``.
+
+    This is the portable-XLA paged read: the gather moves the same
+    bytes decode attention reads anyway (O(B * t_max) per layer per
+    tick), so the paged pool costs one extra HBM round trip vs the
+    dense per-row cache on current XLA:TPU — the block-table Pallas
+    decode kernel (``ops/pallas/decode_attention.py``,
+    ``block_tables=``) is the reference for folding the table lookup
+    into the stream itself. Under a mesh the gather's OUTPUT is
+    constrained to the row-sharded decode layout by the caller, so
+    attached blocks reshard into it via whatever collective the two
+    layouts imply (the arXiv:2112.01075 redistribution move)."""
+    g = pool_leaf[:, table]                    # [s, B, nb, hk, bt, hd]
+    s, B, nb, hk, bt, hd = g.shape
+    return g.transpose(0, 1, 3, 2, 4, 5).reshape(s, B, hk, nb * bt, hd)
+
+
+def _paged_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
+    """One decode tick against the PAGED pool cache format
+    ``{"kv": [2, P, hk, bt, hd], "table": int32 [B, nb]}`` (plus
+    ``"scale"`` for the int8 form): row ``b`` writes its K/V at the
+    physical (block, offset) its table maps logical slot ``pos[b]`` to,
+    then attends over its gathered logical view. The caller (the serve
+    scheduler) guarantees the written block is exclusively owned —
+    shared prefix blocks are copy-on-write BEFORE a row may write into
+    their span, so the write never mutates another row's reads."""
+    from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+        kv_pool_insert_all)
+    from distributed_compute_pytorch_tpu.utils.quantize import quantize_kv
+    table = cache["table"]
+    pool = {n: leaf for n, leaf in cache.items() if n != "table"}
+    bt = pool["kv"].shape[3]
+    pos = jnp.broadcast_to(jnp.atleast_1d(pos), (q.shape[0],))
+    blk = jnp.take_along_axis(table, (pos // bt)[:, None], axis=1)[:, 0]
+    off = pos % bt
+    if "scale" in pool:
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        pool = kv_pool_insert_all(
+            pool, {"kv": jnp.stack([kq, vq]),
+                   "scale": jnp.stack([ks, vs])}, blk, off)
+        kv = gather_kv_blocks(pool["kv"], table)
+        sc = gather_kv_blocks(pool["scale"], table)
+        view = {"k": kv[0], "v": kv[1], "k_scale": sc[0], "v_scale": sc[1]}
+        out = cached_attention_q8(q, view, pos, slot_mask=slot_mask)
+    else:
+        pool = kv_pool_insert_all(pool, {"kv": jnp.stack([k, v])}, blk, off)
+        kv = gather_kv_blocks(pool["kv"], table)
+        out = cached_attention(q, kv[0], kv[1], pos, slot_mask=slot_mask)
+    return out, {**pool, "table": table}
+
+
 def cache_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
     """One decode tick's cache write + attention, for BOTH cache formats.
 
@@ -270,6 +326,12 @@ def cache_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
     """
     from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
         kv_insert_all)
+    if "table" in cache:
+        # PAGED pool format ({"kv": [2, P, hk, bt, hd], "table": [B, nb]},
+        # serve.ContinuousBatcher): the write resolves through the block
+        # table and attention reads the gathered logical view
+        return _paged_write_and_attend(q, k, v, cache, pos,
+                                       slot_mask=slot_mask)
     if "scale" in cache:
         from distributed_compute_pytorch_tpu.utils.quantize import (
             quantize_kv)

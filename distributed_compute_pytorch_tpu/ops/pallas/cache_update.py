@@ -213,6 +213,108 @@ def _rowwise_select(cache, upd, pos):
                      upd.astype(cache.dtype), cache)
 
 
+# ---------------------------------------------------------------------------
+# PAGED-POOL insert — the block-table serving cache (serve.ContinuousBatcher
+# with the paged KV pool). The cache is a pool [2, P, hk, bt, hd] of
+# fixed-size blocks; row b's write lands at PHYSICAL (block[b], offset[b])
+# resolved by the host/table instead of at batch row b. Same one-window-DMA
+# discipline as the per-row kernel: the grid runs one step per decode row,
+# scalar-prefetched (block, offset) pairs pick the pool block and the
+# W-slot window inside it.
+# ---------------------------------------------------------------------------
+
+
+def _pool_rows_kernel(n: int):
+    """Per-decode-row pool write: grid step ``g`` owns update row ``g``
+    and writes it into pool block ``blk[g]`` at slot ``off[g]``
+    ([2, 1, hk, W, w] window blocks, window axis 3). Distinct decode
+    rows always target distinct pool blocks (a row's tail block is
+    exclusively owned — serve's copy-on-write invariant) EXCEPT the
+    shared trash block parked rows write garbage into; TPU grid steps
+    run sequentially on the core, so overlapping trash writes are
+    merely garbage, never a data race."""
+    def kernel(blk_ref, off_ref, *refs):
+        del blk_ref                    # consumed by the index maps
+        g = pl.program_id(0)
+        upds, caches, outs = refs[:n], refs[n:2 * n], refs[2 * n:]
+        for u, c, o in zip(upds, caches, outs):
+            r = off_ref[g] % c.shape[3]
+            blk = c[...]
+            slot = lax.broadcasted_iota(jnp.int32, blk.shape, 3)
+            o[...] = jnp.where(slot == r, u[...], blk)
+    return kernel
+
+
+def kv_pool_insert_rows_pallas(cache: dict, upd: dict, blocks, offsets, *,
+                               interpret: bool = False) -> dict:
+    """Per-row slot write into a PAGED block pool.
+
+    ``cache``: ``{"kv": [2, P, hk, bt, hd]}`` (or the int8
+    ``{"kv", "scale"}`` form) — ``P`` physical blocks of ``bt`` slots.
+    ``upd``: same trees with the pool axis replaced by the decode batch
+    ``B`` and ``bt == 1``. ``blocks``/``offsets``: int32 ``[B]`` — row
+    ``b``'s K/V lands at ``cache[:, blocks[b], :, offsets[b], :]``.
+    ``bt`` must be a multiple of the dtype's window (8 bf16/f32, 32
+    int8). All block ids must be in range (serve points parked rows at
+    the reserved trash block, never out of bounds)."""
+    names = sorted(cache)
+    n = len(names)
+    B = upd[names[0]].shape[1]
+    in_specs = [None] * (2 * n)
+    out_specs, out_shapes, aliases = [], [], {}
+    for i, name in enumerate(names):
+        c = cache[name]
+        s, p, hk, bt, w = c.shape
+        W = _window(c.dtype)
+        assert bt % W == 0, (name, bt, W)
+        in_specs[i] = pl.BlockSpec(
+            (s, 1, hk, 1, w), lambda g, blk_ref, off_ref: (0, g, 0, 0, 0))
+        in_specs[n + i] = pl.BlockSpec(
+            (s, 1, hk, W, w),
+            lambda g, blk_ref, off_ref, W=W:
+            (0, blk_ref[g], 0, off_ref[g] // W, 0))
+        out_specs.append(pl.BlockSpec(
+            (s, 1, hk, W, w),
+            lambda g, blk_ref, off_ref, W=W:
+            (0, blk_ref[g], 0, off_ref[g] // W, 0)))
+        out_shapes.append(jax.ShapeDtypeStruct(c.shape, c.dtype))
+        aliases[2 + n + i] = i         # 2 scalar-prefetch args lead
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(B,),
+        in_specs=in_specs, out_specs=out_specs)
+    outs = pl.pallas_call(
+        _pool_rows_kernel(n),
+        out_shape=out_shapes,
+        grid_spec=grid_spec,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(blocks.astype(jnp.int32), offsets.astype(jnp.int32),
+      *[upd[k].astype(cache[k].dtype) for k in names],
+      *[cache[k] for k in names])
+    return dict(zip(names, outs))
+
+
+def _pool_scatter(cache, upd, blocks, offsets):
+    """XLA fallback for the pool write: one scatter at the per-row
+    (block, offset) pairs. ``mode="drop"`` discards out-of-range block
+    ids, which the serve layer uses for admission pad rows."""
+    # advanced indices at axes (1, 3) land broadcast-first: the target
+    # region is [B, s, hk, w]
+    u = jnp.moveaxis(upd[:, :, :, 0, :], 1, 0).astype(cache.dtype)
+    return cache.at[:, blocks, :, offsets, :].set(u, mode="drop")
+
+
+def kv_pool_insert_all(cache: dict, upd: dict, blocks, offsets) -> dict:
+    """Dispatcher for the paged pool write: the per-row Pallas kernel on
+    an unsharded single-device TPU (one window DMA per decode row), an
+    XLA scatter elsewhere (CPU tests; sharded pools, where a pallas call
+    would defeat the GSPMD layout)."""
+    if _pallas_ok(cache, axis=3):
+        return kv_pool_insert_rows_pallas(cache, upd, blocks, offsets)
+    return {k: _pool_scatter(cache[k], upd[k], blocks, offsets)
+            for k in cache}
+
+
 def _pair_rows_kernel(n: int):
     """Per-row variant of :func:`_pair_kernel`: grid step ``b`` owns
     batch row ``b``'s window block ([2, 1, hk, W, w], window axis 3) at

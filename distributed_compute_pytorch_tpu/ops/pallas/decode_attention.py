@@ -173,6 +173,164 @@ def _kernel(pos_ref, q_ref, k_hbm, v_hbm, out_ref, *, block_pairs: int,
     )
 
 
+def _paged_kernel(pos_ref, tbl_ref, q_ref, k_hbm, v_hbm, out_ref, *,
+                  block_pairs: int, scale: float, num_heads: int,
+                  nb: int):
+    """Block-table variant of :func:`_kernel`: the caches are a POOL of
+    fixed-size blocks ``[P, Hk, bt/2, 2hd]`` (packed-lane pair view) and
+    row ``b``'s logical block ``j`` streams from physical block
+    ``tbl_ref[b * nb + j]`` — the paged-attention read, where the
+    per-row DMA source is a table lookup instead of a contiguous slice.
+    One pool block == one DMA chunk, so the dynamic length bound
+    (``pos[b] // bt + 1`` blocks) never fetches past a row's live
+    prefix. Same online-softmax/packed-lane math as the dense kernel."""
+    b = pl.program_id(0)
+    total_pairs = block_pairs * nb
+    pos = jnp.minimum(pos_ref[b], total_pairs * 2 - 1)
+    nblk = (pos // 2) // block_pairs + 1
+    G = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    zeros = jnp.zeros((G, hd), jnp.float32)
+    q_all = q_ref[0].astype(jnp.float32) * scale
+    q_even = [jnp.concatenate([q_all[h], zeros], axis=1)
+              for h in range(num_heads)]
+    q_odd = [jnp.concatenate([zeros, q_all[h]], axis=1)
+             for h in range(num_heads)]
+    eye = jnp.eye(hd, dtype=jnp.float32)
+    fold = jnp.concatenate([eye, eye], axis=0)
+
+    def body(scratch_k, scratch_v, sem_k, sem_v):
+        def dma(slot, kb, which):
+            hbm, scr, sem = ((k_hbm, scratch_k, sem_k) if which == 0
+                             else (v_hbm, scratch_v, sem_v))
+            phys = tbl_ref[b * nb + kb]        # the table lookup
+            return pltpu.make_async_copy(
+                hbm.at[phys], scr.at[slot], sem.at[slot])
+
+        dma(0, 0, 0).start()
+        dma(0, 0, 1).start()
+
+        def block_step(kb, carry):
+            ms, ls, accs = carry
+            slot = kb % 2
+            nxt = (kb + 1) % 2
+
+            @pl.when(kb + 1 < nblk)
+            def _():
+                dma(nxt, kb + 1, 0).start()
+                dma(nxt, kb + 1, 1).start()
+
+            dma(slot, kb, 0).wait()
+            dma(slot, kb, 1).wait()
+
+            base = kb * block_pairs * 2
+            new_m, new_l, new_acc = [], [], []
+            for h in range(num_heads):
+                kp = scratch_k[slot][h].astype(jnp.float32)
+                vp = scratch_v[slot][h].astype(jnp.float32)
+                s_even = jax.lax.dot_general(
+                    q_even[h], kp, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                s_odd = jax.lax.dot_general(
+                    q_odd[h], kp, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                ids = base + 2 * lax.broadcasted_iota(jnp.int32,
+                                                      s_even.shape, 1)
+                s_even = jnp.where(ids <= pos, s_even, -1e30)
+                s_odd = jnp.where(ids + 1 <= pos, s_odd, -1e30)
+
+                m, l, acc = ms[h], ls[h], accs[h]
+                blk_max = jnp.maximum(
+                    jnp.max(s_even, axis=1, keepdims=True),
+                    jnp.max(s_odd, axis=1, keepdims=True))
+                m_new = jnp.maximum(m, blk_max)
+                alpha = jnp.exp(m - m_new)
+                p_even = jnp.exp(s_even - m_new)
+                p_odd = jnp.exp(s_odd - m_new)
+                l_new = (l * alpha
+                         + jnp.sum(p_even, axis=1, keepdims=True)
+                         + jnp.sum(p_odd, axis=1, keepdims=True))
+                pv_e = jax.lax.dot_general(
+                    p_even, vp, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                pv_o = jax.lax.dot_general(
+                    p_odd, vp, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                lane = lax.broadcasted_iota(jnp.int32, pv_e.shape, 1)
+                contrib = jnp.where(lane < hd, pv_e, pv_o)
+                new_m.append(m_new)
+                new_l.append(l_new)
+                new_acc.append(acc * alpha + contrib)
+            return (tuple(new_m), tuple(new_l), tuple(new_acc))
+
+        m0 = tuple(jnp.full((G, 1), -jnp.inf, jnp.float32)
+                   for _ in range(num_heads))
+        l0 = tuple(jnp.zeros((G, 1), jnp.float32)
+                   for _ in range(num_heads))
+        acc0 = tuple(jnp.zeros((G, 2 * hd), jnp.float32)
+                     for _ in range(num_heads))
+        _, ls, accs = lax.fori_loop(0, nblk, block_step, (m0, l0, acc0))
+        for h in range(num_heads):
+            out = jax.lax.dot_general(accs[h] / ls[h], fold,
+                                      (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            out_ref[0, h] = out.astype(out_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        scratch_k=pltpu.VMEM((2, num_heads, block_pairs, 2 * hd),
+                             k_hbm.dtype),
+        scratch_v=pltpu.VMEM((2, num_heads, block_pairs, 2 * hd),
+                             v_hbm.dtype),
+        sem_k=pltpu.SemaphoreType.DMA((2,)),
+        sem_v=pltpu.SemaphoreType.DMA((2,)),
+    )
+
+
+def decode_attention_paged_pallas(q, k_pool, v_pool, tables, pos, *,
+                                  scale: float | None = None):
+    """Paged flash-decode: ``q [B, Hk, G, hd]`` against a BLOCK POOL
+    ``k_pool/v_pool [P, Hk, bt, hd]`` addressed through ``tables
+    [B, nb]`` (row ``b``'s logical slot ``t`` lives in pool block
+    ``tables[b, t // bt]`` at offset ``t % bt``); attends logical slots
+    ``0..pos[b]``. The pool block is the DMA unit, so the stream
+    touches exactly the blocks a row's live prefix occupies — the
+    block-table analogue of the dense kernel's dynamic length bound.
+
+    Reference status, like the dense kernel above (measured-rejected as
+    the default decode path on v5e): the per-(batch,head) GEMV shape
+    underuses the MXU regardless of how K/V are addressed; kept
+    correct + covered for future hardware/compiler revisions, and as
+    the recipe for fusing the table lookup into the stream. ``hd`` must
+    be 64 and ``bt`` even (the packed-lane layout)."""
+    B, Hk, G, hd = q.shape
+    P, _, bt, _ = k_pool.shape
+    nb = tables.shape[1]
+    assert hd == 64, hd
+    assert bt % 2 == 0, bt
+    scale = (hd ** -0.5) if scale is None else scale
+    block_pairs = bt // 2
+    kp = k_pool.reshape(P, Hk, bt // 2, 2 * hd)
+    vp = v_pool.reshape(P, Hk, bt // 2, 2 * hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hk, G, hd), lambda b, p, t: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hk, G, hd), lambda b, p, t: (b, 0, 0, 0)),
+    )
+    pos = jnp.broadcast_to(jnp.atleast_1d(pos).astype(jnp.int32), (B,))
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, block_pairs=block_pairs,
+                          scale=scale, num_heads=Hk, nb=nb),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+    )(pos, tables.reshape(-1).astype(jnp.int32), q, kp, vp)
+
+
 def decode_attention_pallas(q, k_cache, v_cache, pos, *,
                             scale: float | None = None,
                             block_k: int = 128):

@@ -8,140 +8,141 @@ finish early and their slots then burn ticks emitting garbage until the
 longest row ends. This module keeps a fixed pool of ``slots`` busy
 instead, with everything the TPU touches remaining static-shaped:
 
+- **Paged block-pool KV cache**: each layer's cache is a POOL of
+  fixed-size blocks ``{"kv": [2, pool_blocks, hk, kv_block_tokens,
+  hd]}`` (block size a multiple of the Pallas cache window —
+  ``ops/pallas/cache_update.py::_window`` — static shapes throughout),
+  and each cache row maps its LOGICAL slot range ``[0, t_max)`` onto
+  physical blocks through a per-row block table ``[slots, t_max // bt]``
+  shipped with every dispatch. Admission allocates a request's blocks
+  from a host-side refcounted free list (``kv_pool.BlockPool``); decode
+  writes resolve ``pos -> (table[pos // bt], pos % bt)`` (one window
+  DMA per row on the Pallas path — ``kv_pool_insert_rows_pallas``) and
+  attention reads the row's gathered logical view
+  (``ops/attention.py::cache_write_and_attend``, paged format). Rows no
+  longer own contiguous cache memory, which is what makes PREFIX
+  SHARING possible at all. Parked/free rows point at the reserved
+  trash block, where their per-tick garbage writes can never corrupt a
+  live or cached block.
+- **Radix prefix cache** (``prefix_cache=True``): a host-side radix
+  tree over prompt-HEAD tokens (``kv_pool.RadixCache``) maps a new
+  request's longest cached prefix to already-prefilled blocks. The
+  request ATTACHES: full blocks are shared read-only (refcount++), a
+  prefix ending mid-block is COPY-ON-WRITE (the partial block is
+  device-copied before the row may write into its span), and only the
+  unshared suffix runs prefill — repeated prefill compute becomes a
+  block lookup, the production traffic shape where thousands of
+  requests share a long system prompt. Admission lays every prompt out
+  from LOGICAL SLOT 0 (tokens-then-free, no left padding), so a shared
+  token prefix always produces bit-identical K/V at identical
+  positions — the invariant that makes attaching exact: learned
+  positions embed the logical index, RoPE keys rotate at their own
+  absolute slots, and the (seed, tokens-generated) sampling key
+  schedule is position-based, so greedy AND sampled streams stay
+  token-identical to the cache-off path. Eviction is LRU over tree
+  entries, freeing refcount-0 blocks only. MoE models are refused:
+  routing is group-dependent, so a suffix-only group cannot reproduce
+  the standalone queues when capacity binds.
 - **Decode segments**: one jitted ``lax.scan`` of ``segment`` ticks over
   all slots (the same per-tick math as ``infer.py`` — ``decode_step``
-  per block, in-place cache writes, per-row sampling). Caches/tokens
-  carry ACROSS calls as donated buffers, so consecutive segments reuse
-  the same compiled program at zero re-trace cost.
-- **Per-row positions**: every cache row advances an INDEPENDENT write
-  position (``decode_step`` takes a ``[B]`` position vector; the Pallas
-  slot write is per-row — ``ops/pallas/cache_update.py::
-  kv_insert_rows_pallas`` — and decode attention masks each row at its
-  own valid length). Admission writes a new prompt at the ROW'S OWN
-  window ``[0, prompt_buf)`` — no global position to align to, no
-  shared ``prompt_buf`` burn — and rewinds that row to slot
-  ``prompt_buf - 1``. ``t_max`` is therefore a PER-REQUEST length
-  bound, not a session-wide tick budget: rows recycle indefinitely on
-  the same compiled programs and a session never exhausts.
+  per block, in-place pool writes, per-row sampling). Pool/tokens carry
+  ACROSS calls as donated buffers, so consecutive segments reuse the
+  same compiled program at zero re-trace cost.
+- **Per-row positions**: every row advances an INDEPENDENT write
+  position (``decode_step`` takes a ``[B]`` position vector); a row's
+  prompt head occupies logical slots ``[0, n-1)`` and decode continues
+  at slot ``n-1`` — ``t_max`` is a PER-REQUEST length bound, rows
+  recycle indefinitely on the same compiled programs and a session
+  never exhausts.
 - **Batched admission**: ALL pending prompts that fit free rows are
-  stacked into ONE compiled multi-row prefill per admission wave (a
-  ``[K, prompt_buf]`` left-padded batch scattered into the K freed
-  cache rows) instead of a batch-1 call per request — k admissions cost
-  one dispatch, not k. Each prompt — all tokens but its last — is
-  prefilled; the LAST prompt token becomes the row's current token,
-  consumed by the next segment's first tick at slot ``prompt_buf``
-  exactly as standalone generation would (and keeping admission
-  fetch-free — see ``_admit_impl``). Per-row ``slot_mask`` rows hide
-  the pad slots; the per-row position mask hides everything the row's
-  previous occupant left beyond the live position. Positions stay
-  exact per family: learned-position models embed LOGICAL positions
-  (0..n-1 per row), rope models rope at ABSOLUTE PER-ROW slots, and
-  RoPE scores depend only on within-row slot differences, which the
-  fixed window offset preserves. (The wave size ``K`` is a compiled
-  shape — distinct wave sizes compile once each, bounded by ``slots``.)
-- **Mesh composition**: pass ``mesh=`` (same contract as
-  ``infer.make_generate_fn``) and the WHOLE serving session is sharded:
-  cache rows over the batch axes (``data``/``fsdp``), KV heads over
-  ``tensor`` (GQA: ``tensor`` must divide ``num_kv_heads``), expert
-  FFNs over ``expert`` — the layout ``infer._CACHE_SPEC`` names, the
-  same one the params trained under. The admission prefill computes at
-  its own (batch-K, tensor/expert-sharded) layout and its K/V output is
-  RESHARDED into the row-sharded cache layout by the scatter that
-  writes the freed rows — the portable-redistribution move
-  (arXiv:2112.01075): XLA inserts the collective the two layouts imply,
-  and no cache is ever gathered to one device.
+  stacked into ONE compiled multi-row prefill per admission wave.
+  Each prompt's tokens-but-the-last are prefilled (its SUFFIX past any
+  cached prefix, attended against the gathered prefix K/V via the
+  blocks' ``kv_prefix`` path); the LAST prompt token becomes the row's
+  current token, consumed by the next segment's first tick exactly as
+  standalone generation would — admission stays fetch-free. With the
+  prefix cache off every wave compiles at the one ``prompt_buf``-wide
+  window, exactly as before; attach waves compile per
+  (suffix-window, prefix-window) shape, both rounded to the block size
+  so the recurring hot-prefix traffic reuses a handful of programs.
+- **Mesh composition**: pass ``mesh=`` and the WHOLE serving session is
+  sharded: pool BLOCKS over the batch axes (``data``/``fsdp``), KV
+  heads over ``tensor`` (GQA: ``tensor`` must divide ``num_kv_heads``),
+  expert FFNs over ``expert`` (``infer._POOL_SPEC``). A row's blocks
+  may live on any device; the per-tick gather's output is constrained
+  back to the row-sharded decode layout, so XLA inserts whatever
+  collective the two layouts imply — the portable-redistribution move
+  (arXiv:2112.01075) that resharded admission K/V in the dense design
+  now reshards attached blocks.
 - **Overlapped host scheduler**: a plain queue, with the single
-  device->host fetch per segment (the token harvest, ~130 ms on the
-  relayed transport) OVERLAPPED with the next segment's execution:
-  segment N+1 is dispatched BEFORE segment N's tokens are fetched.
-  This is sound because rows are computationally independent — a row's
-  tokens depend only on its own cache, never on when its neighbours
-  were admitted — and budget completion is host-known (a row with
-  ``remaining <= segment`` at dispatch is parked for the next segment
-  without waiting for its tokens). Only eos is device-data-dependent:
-  an eos'd row burns at most the one segment that was already in
-  flight when the host learns of it, and those ticks are trimmed at
-  harvest — served tokens are IDENTICAL to the unoverlapped schedule,
-  admission simply lags one segment behind a row's (eos) completion.
+  device->host fetch per segment (the token harvest) OVERLAPPED with
+  the next segment's execution: segment N+1 is dispatched BEFORE
+  segment N's tokens are fetched. Sound because rows are
+  computationally independent and budget completion is host-known;
+  an eos'd row burns at most the one in-flight segment. A freed row's
+  blocks return to the pool at harvest; the one in-flight segment may
+  still write garbage through the row's OLD table, which is harmless
+  by construction: any re-allocated block is fully overwritten by the
+  (later-ordered) admission prefill over the slots it exposes, and
+  slots beyond a row's live position are never attended.
 
 **Admission fairness (the documented contract).** ``admit_policy=
 "fifo"`` (default): requests are admitted strictly in arrival order —
 a free row always takes the QUEUE HEAD, and no request is ever
-leapfrogged by a later one. Because every row offers the same horizon
-(per-row positions admit at the same window offset every time), a
-request whose segment-rounded budget can never fit (``prompt_buf +
+leapfrogged by a later one. Because every row offers the same horizon,
+a request whose segment-rounded budget can never fit (``prompt_buf +
 ceil(max_new/segment)*segment > t_max``) would block the head FOREVER,
 so infeasibility is resolved up front: such requests are set aside,
 everything else is served to completion, then :class:`HorizonError` is
-raised CARRYING the completed outputs (``.outputs``) instead of
-discarding finished work. ``admit_policy="skip_fit"`` opts out of the
-head-of-line guarantee: each free row takes the FIRST queued request
-whose rounded need fits it (today that predicate is row-independent,
-so the two policies admit identical streams; skip_fit is the hook for
-deployments whose rows expose heterogeneous free horizons, and it
-handles never-fitting requests by skipping them in place rather than
-gating up front — same terminal ``HorizonError``).
+raised CARRYING the completed outputs (``.outputs``).
+``admit_policy="skip_fit"`` opts out of the head-of-line guarantee
+(class docstring).
 
 **Sampling.** Each request carries its own ``temperature`` (0 =
 greedy), ``top_k``, ``top_p`` and ``seed``; the compiled segment
 samples every row from its own settings and its own counter-based key
-stream (``infer.sample_rows``; keys are pre-split per segment outside
-the scan, the same discipline as ``infer.py`` — an in-scan split chain
-costs more than the tick's math). The key for a row's t-th token
-depends only on (seed, tokens-so-far), so sampled outputs are
-deterministic AND invariant to ``slots``/``segment`` scheduling; a
-greedy request served next to sampling requests keeps standalone
-parity (``tests/test_serve.py``).
+stream (``infer.sample_rows``). The key for a row's t-th token depends
+only on (seed, tokens-so-far), so sampled outputs are deterministic AND
+invariant to ``slots``/``segment`` scheduling — and to prefix
+attachment, which changes where K/V come from but not a single logical
+position.
 
 Correctness contract (``tests/test_serve.py``,
-``tests/test_serve_mesh.py``): greedy-served outputs of staggered
-admissions equal each prompt's standalone ``infer.generate``, token
-for token, for GPT-2 (learned positions), Llama (RoPE/GQA) and the
-MoE family (inference routing) — off-mesh and under data/tensor/
-expert-sharded meshes (sharded serving compares against sharded
-standalone generation: cross-LAYOUT equality is only a logits-
-tolerance property, see ``tests/test_generate.py``). MoE capacity:
-although an admission wave prefills rows over the fixed ``prompt_buf``
-window, each row is its OWN routing group whose expert queue capacity
-derives from that row's REAL prompt length (``moe_capacity_rows`` —
-``MoEBlock.prefill_capacity``/``MoELayer.apply``), and pad tokens
-claim no queue slot, so every prefilled prompt routes with exactly the
-queues a standalone global-group prefill gives it even when capacity
-binds. The remaining documented no-drop contract is only the LAST
-prompt token: serve defers it to the first decode tick, which is
-full-capacity by construction, while the standalone prefill routes it
-with capacity ``C`` — the paths can disagree only if the standalone
-run capacity-drops that one token (``tests/test_serve.py`` pins both
-the binding-capacity parity and this boundary).
+``tests/test_serve_mesh.py``, ``tests/test_kv_pool.py``):
+greedy-served outputs of staggered admissions equal each prompt's
+standalone ``infer.generate``, token for token, for GPT-2 (learned
+positions), Llama (RoPE/GQA) and the MoE family — off-mesh and under
+data/tensor/expert-sharded meshes — and prefix-cache-ON serving equals
+prefix-cache-OFF serving token for token, greedy and sampled, with
+zero block leaks after drain. MoE capacity: each admission-wave row is
+its OWN routing group whose expert queue capacity derives from that
+row's REAL prompt length (``moe_capacity_rows``); the documented
+no-drop contract on the deferred last prompt token is unchanged.
 
 **Fault tolerance (serve_detailed — the failure domain is ONE
-request, never the process).** The legacy ``serve()`` is
-all-or-nothing; :meth:`ContinuousBatcher.serve_detailed` runs the same
-engine with the request lifecycle threaded through the host scheduler's
-decision points: per-request wall-clock deadlines and thread-safe
-:meth:`cancel` (partial streams returned), bounded admission with load
-shedding (``max_pending``), graceful drain off any ``.preempted`` flag
-(``train/elastic.PreemptionGuard``: admission stops, in-flight rows
-finish within the drain deadline, completed outputs are returned), and
-DEVICE-FAILURE SESSION RECONSTRUCTION — a raised segment/harvest or a
-harvest hung past the ``tick_timeout_s`` watchdog rebuilds every live
-row by re-prefilling ``prompt + generated-so-far`` from host-tracked
-state and resumes decode TOKEN-IDENTICALLY (host-known prefixes +
-(seed, tokens-so-far) sampling keys make replay exact; ``_reconstruct``
-carries the soundness argument, DESIGN.md "Serving under failure" the
-long form). Every request ends in a structured
-``serve_lifecycle.RequestResult``; chaos drills
-(``serve_lifecycle.ChaosInjector``, ``tests/test_serve_faults.py``,
-``bench.py --serve-chaos-smoke``) exercise each path.
+request, never the process).** Per-request deadlines, thread-safe
+:meth:`cancel`, bounded admission with load shedding (``max_pending``),
+graceful drain off any ``.preempted`` flag, and DEVICE-FAILURE SESSION
+RECONSTRUCTION: a raised segment/harvest or a harvest hung past the
+``tick_timeout_s`` watchdog zeroes the untrusted device pool, resets
+the host block accounting AND the radix cache (its content died with
+the pool), and re-prefills every live row's ``prompt +
+generated-so-far`` from host-tracked state — token-IDENTICAL resume
+(``_reconstruct`` carries the soundness argument, DESIGN.md "Paged KV
+and prefix reuse" / "Serving under failure" the long form). Every
+request ends in a structured ``serve_lifecycle.RequestResult`` carrying
+its cached-prefix length.
 
-Instrumentation (the transport counters ``make bench-smoke`` asserts):
-``stats`` counts segments, fetches (exactly one per segment),
-overlapped fetches (the next segment was already dispatched when the
-fetch was issued) and prefill calls/rows (one call per admission
-wave), plus the fault-tolerance counters (faults, reconstructions,
-reconstruction rows, recovery seconds); ``waste`` attributes every
-non-useful row-tick to post-eos/budget tail, admission lag, or final
-drain (the serve bench's ``waste_breakdown``).
+Instrumentation: ``stats`` counts segments, fetches, overlapped
+fetches, prefill calls/rows, the fault-tolerance counters, and the
+prefix-cache counters — ``prefix_hits`` (admissions that attached),
+``cached_prefix_tokens`` / ``prefill_tokens_saved`` (tokens attached
+instead of re-prefilled), ``cow_copies``, ``block_pool_occupancy``
+(peak allocated fraction). ``last_block_leaks`` extends the PR 5
+slot-leak discipline to blocks: after a serve call every pool
+reference must be owned by the radix tree (or the pinned trash block)
+— asserted by tests and the bench smokes alongside
+``last_slot_leaks``.
 """
 
 from __future__ import annotations
@@ -162,7 +163,8 @@ from jax.sharding import PartitionSpec as P
 from distributed_compute_pytorch_tpu.core.mesh import (
     constrain, named_sharding, use_mesh)
 from distributed_compute_pytorch_tpu.infer import (
-    _CACHE_SPEC, _constrain_cache, sample_rows)
+    _CACHE_SPEC, _POOL_SPEC, sample_rows)
+from distributed_compute_pytorch_tpu.kv_pool import BlockPool, RadixCache
 from distributed_compute_pytorch_tpu.serve_lifecycle import (
     CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
 from distributed_compute_pytorch_tpu.train.elastic import call_with_timeout
@@ -203,12 +205,14 @@ class _Slot:
     remaining: int = 0
     out: list = field(default_factory=list)
     admit_seq: int = -1        # admission order (poison-eviction heuristic)
+    blocks: list = field(default_factory=list)   # owned pool block refs
 
     def free(self):
         self.req_index = -1
         self.remaining = 0
         self.out = []
         self.admit_seq = -1
+        self.blocks = []
 
 
 class HorizonError(RuntimeError):
@@ -225,53 +229,48 @@ class HorizonError(RuntimeError):
 
 
 class ContinuousBatcher:
-    """Fixed-pool continuous batching for one causal LM.
+    """Fixed-pool continuous batching for one causal LM, over a paged
+    block-table KV cache.
 
     Args:
       model: any ``infer.py``-contract model (GPT-2 / Llama / MoE).
       params: its (possibly quantized) parameters — already committed
-        to the mesh layout when ``mesh`` is given (restore with
-        ``parallel.api.shard_pytree`` under the training strategy).
+        to the mesh layout when ``mesh`` is given.
       slots: cache rows decoding concurrently (the static batch). Under
         a mesh it must divide over the batch axes
-        (``data * fsdp | slots``) so every device owns whole rows.
-      t_max: cache length == each ROW's length bound: one request needs
+        (``data * fsdp | slots``).
+      t_max: each ROW's logical length bound: one request needs
         ``prompt_buf + ceil(max_new/segment)*segment <= t_max``. Rounded
-        up to the Pallas cache-window multiple (8 for bf16/f32 caches,
-        32 for int8 — ``ops/pallas/cache_update.py::_window``), exactly
-        as ``infer.make_generate_fn`` does: a misaligned length would
-        silently drop every tick onto the ~3x-slower full-cache-copy
-        ``dynamic_update_slice`` path, and the extra slots are never
-        attended (the per-row position mask stops at each row's live
-        position), so rounding up is observationally free.
+        up to the block size so every row's table covers whole blocks
+        (the block size itself is window-aligned, so this subsumes the
+        old Pallas-window rounding; extra slots are never attended).
       prompt_buf: static prompt window; prompts longer than this are
         rejected (size it to the workload's longest prompt).
-      segment: ticks per compiled decode call. Smaller = finer admission
-        granularity (less tail waste when a row finishes mid-segment)
-        but more host round-trips; the serve bench's ``segment_sweep``
-        and ``waste_breakdown`` (bench.py ``serve_long_stream``) carry
-        the measured trade-off for the headline workload.
+      segment: ticks per compiled decode call.
       eos_id: optional stop token (rows stop early and free their slot).
       mesh: optional ``jax.sharding.Mesh`` — SHARDED serving (module
-        docstring). Batch axes shard the cache rows, ``tensor`` the KV
-        heads (must divide ``num_kv_heads``), ``expert`` the expert
-        FFNs; ``seq`` is rejected (decode has no sequence to shard).
-      admit_policy: ``"fifo"`` (strict arrival order — the fairness
-        contract in the module docstring) or ``"skip_fit"``.
-      max_pending: bounded admission — at submission, at most
-        ``slots + max_pending`` requests are accepted; the rest are
-        finalised ``shed`` with zero device work (overload rejects
-        cheaply instead of queueing unboundedly). ``None`` = unbounded
-        (the legacy contract).
-      tick_timeout_s: the tick watchdog — wall-clock budget for each
-        segment's token harvest (the loop's single device->host fetch,
-        where a dead or wedged device surfaces). On expiry the session
-        is RECONSTRUCTED (``_reconstruct``) instead of hanging forever.
-        ``None`` = no watchdog (and no per-segment worker thread).
-      max_recoveries: how many session reconstructions one
-        ``serve_detailed`` call may attempt before declaring the device
-        lost and failing the remaining requests (each carrying the
-        underlying error).
+        docstring): pool blocks over the batch axes, KV heads over
+        ``tensor`` (must divide ``num_kv_heads``), expert FFNs over
+        ``expert``; ``seq`` is rejected.
+      admit_policy: ``"fifo"`` (default) or ``"skip_fit"``.
+      max_pending: bounded admission (``None`` = unbounded).
+      tick_timeout_s: the tick watchdog (``None`` = no watchdog).
+      max_recoveries: session reconstructions per ``serve_detailed``
+        call before declaring the device lost.
+      kv_block_tokens: logical slots per pool block (default: the
+        Pallas cache window — 8 for bf16/f32 caches; rounded up to a
+        window multiple otherwise). Smaller blocks share prefixes at a
+        finer grain; larger blocks cut table length and per-wave
+        compile variety.
+      prefix_cache: enable the radix prefix cache (module docstring).
+        Off by default — the paged pool alone is behaviour-identical to
+        the old dense-window design. Refused for MoE models (routing is
+        group-dependent).
+      pool_blocks: physical blocks in the pool (default:
+        ``slots * (t_max // bt) + 1`` — every row can always allocate
+        its worst-case table after LRU eviction — plus 4 rows' worth of
+        cache headroom when ``prefix_cache`` is on). Rounded up to a
+        batch-axes multiple under a mesh.
     """
 
     def __init__(self, model, params, *, slots: int, t_max: int,
@@ -280,7 +279,10 @@ class ContinuousBatcher:
                  admit_policy: str = "fifo",
                  max_pending: int | None = None,
                  tick_timeout_s: float | None = None,
-                 max_recoveries: int = 2):
+                 max_recoveries: int = 2,
+                 kv_block_tokens: int | None = None,
+                 prefix_cache: bool = False,
+                 pool_blocks: int | None = None):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -296,6 +298,9 @@ class ContinuousBatcher:
         if max_recoveries < 0:
             raise ValueError(
                 f"max_recoveries must be >= 0, got {max_recoveries}")
+        if kv_block_tokens is not None and kv_block_tokens < 1:
+            raise ValueError(
+                f"kv_block_tokens must be >= 1, got {kv_block_tokens}")
         self.max_pending = max_pending
         self.tick_timeout_s = tick_timeout_s
         self.max_recoveries = max_recoveries
@@ -314,12 +319,23 @@ class ContinuousBatcher:
         # at admission)? Llama does; GPT-2/MoE embed positions instead.
         sig = inspect.signature(self._block.apply).parameters
         self._block_takes_positions = "positions" in sig
+        self._block_takes_kv_prefix = "kv_prefix" in sig
         # MoE admission capacity (ADVICE r5): blocks whose prefill routing
         # accepts an explicit capacity get it derived from the REAL prompt
-        # length, not the padded window (see _admit_impl); the per-row
+        # length, not the padded window (see _prefill_wave); the per-row
         # form carries each wave row's own capacity
         self._block_takes_moe_capacity = "moe_capacity" in sig
         self._block_takes_moe_capacity_rows = "moe_capacity_rows" in sig
+        if prefix_cache and self._block_takes_moe_capacity:
+            # MoE routing is group-dependent: a suffix-only admission
+            # group cannot reproduce the standalone full-prompt expert
+            # queues when capacity binds, so attached serving could
+            # silently diverge from the cache-off path — refuse instead
+            raise ValueError(
+                "prefix_cache does not compose with MoE models (routing "
+                "is group-dependent; a cached prefix cannot be skipped "
+                "without changing the suffix's routing group)")
+        self.prefix_cache = prefix_cache
         hk, hd = model.kv_cache_spec()
         if mesh is not None:
             shape = dict(mesh.shape)
@@ -346,19 +362,32 @@ class ContinuousBatcher:
             self._dp = 1
         n_layers = int(jax.tree_util.tree_leaves(
             params["blocks"])[0].shape[0])
-        # cache rows in the activations' dtype == the first floating
+        # cache blocks in the activations' dtype == the first floating
         # param leaf's (bf16 serving params -> bf16 cache; int8-quantized
         # trees surface their float scales, same outcome)
         floats = [l for l in jax.tree.leaves(params)
                   if jnp.issubdtype(l.dtype, jnp.floating)]
         dtype = floats[0].dtype if floats else jnp.float32
-        # ADVICE r5: align t_max to the in-place Pallas slot write's
-        # window so serving never silently falls off the fast path
+        # block size: a multiple of the in-place Pallas slot write's
+        # window so the paged write keeps the one-window-DMA fast path;
+        # t_max rounds up to whole blocks (ADVICE r5's alignment move,
+        # now at block granularity — observationally free, the per-row
+        # position mask stops at each row's live position)
         align = _window(dtype)
-        self.t_max = -(-t_max // align) * align
-        # per-layer KV-PAIR arrays [2(k/v), B, hk, T, hd]: each tick's
-        # slot write is one window DMA per row per layer
-        # (ops/pallas/cache_update.py::kv_insert_rows_pallas)
+        bt = kv_block_tokens if kv_block_tokens is not None else align
+        self.bt = -(-bt // align) * align
+        self.t_max = -(-t_max // self.bt) * self.bt
+        self.nb = self.t_max // self.bt          # table entries per row
+        min_blocks = slots * self.nb + 1         # + the trash block
+        if pool_blocks is None:
+            pool_blocks = min_blocks + (4 * self.nb if prefix_cache else 0)
+        if pool_blocks < min_blocks:
+            raise ValueError(
+                f"pool_blocks={pool_blocks} < slots*blocks_per_row+1="
+                f"{min_blocks}: a full pool could deadlock admission "
+                f"(eviction frees only refcount-0 blocks)")
+        # blocks shard over the batch axes: keep the axis divisible
+        pool_blocks = -(-pool_blocks // self._dp) * self._dp
         self._n_layers = n_layers
 
         def dev(x, spec):
@@ -366,9 +395,12 @@ class ContinuousBatcher:
                 return x
             return jax.device_put(x, named_sharding(mesh, spec))
 
+        # per-layer block POOLS [2(k/v), P, hk, bt, hd]: each tick's
+        # write is one window DMA per row through the block table
+        # (ops/pallas/cache_update.py::kv_pool_insert_rows_pallas)
         self._caches = [
-            {"kv": dev(jnp.zeros((2, slots, hk, self.t_max, hd), dtype),
-                       _CACHE_SPEC)}
+            {"kv": dev(jnp.zeros((2, pool_blocks, hk, self.bt, hd), dtype),
+                       _POOL_SPEC)}
             for _ in range(n_layers)]
         if (jax.default_backend() == "tpu"
                 and (mesh is not None
@@ -376,17 +408,24 @@ class ContinuousBatcher:
             warnings.warn(
                 "serving caches fall off the Pallas window-write fast "
                 "path (mesh active, multi-device, or a non-window-"
-                "aligned shape): every decode tick will pay the full-"
-                "cache-copy dynamic_update_slice (~3x slower measured)",
+                "aligned block size): every decode tick will pay a "
+                "full-pool-copy scatter (~3x slower measured for the "
+                "dense analogue)",
                 stacklevel=2)
         row_spec = P(("data", "fsdp"))
-        self._slot_mask = dev(jnp.zeros((slots, self.t_max), jnp.float32),
-                              P(("data", "fsdp"), None))
         self._cur_tok = dev(jnp.zeros((slots,), jnp.int32), row_spec)
         self._n_logical = dev(jnp.zeros((slots,), jnp.int32), row_spec)
+        # host-side paged-cache state: the refcounted block pool, the
+        # per-row block tables (shipped with every dispatch; trash = 0),
+        # and the radix prefix cache
+        self._pool = BlockPool(pool_blocks)
+        self._tables = np.full((slots, self.nb), BlockPool.TRASH, np.int32)
+        self._radix = (RadixCache(self._pool, self.bt)
+                       if prefix_cache else None)
         # per-row slot of the last written token (host-tracked: admission
-        # rewinds a row to Tb-1, each segment advances every row by S)
-        self._row_pos = [prompt_buf - 1] * slots
+        # rewinds a row to its head length - 1; each segment advances
+        # every row by S; parked rows sit at 0 writing into trash)
+        self._row_pos = [0] * slots
         # per-row sampling settings (host-tracked, set at admission,
         # shipped with every segment dispatch — no fetch)
         self._temp = np.zeros((slots,), np.float32)
@@ -397,13 +436,16 @@ class ContinuousBatcher:
         self._zero_stats()
         # moe_capacity is STATIC: capacity shapes the routing one-hots, so
         # each distinct (wave size, wave-max capacity) pair compiles its
-        # own admission program (bounded by slots x the same per-shape
-        # compilation the standalone prefill always paid); per-row
-        # capacities ride along as a traced [K] vector
-        self._admit_c = jax.jit(self._admit_impl, donate_argnums=(1, 2),
+        # own admission program; per-row capacities ride along as a
+        # traced [K] vector. Suffix/prefix window widths are static per
+        # wave too — the prefix-cache-off path always compiles the one
+        # prompt_buf-wide window, attach waves one program per
+        # block-rounded (suffix, prefix) pair.
+        self._admit_c = jax.jit(self._admit_impl, donate_argnums=(1,),
                                 static_argnames=("moe_capacity",))
         self._segment_c = jax.jit(self._segment_impl, donate_argnums=(1,),
                                   static_argnames=("sampling",))
+        self._copy_c = jax.jit(self._copy_impl, donate_argnums=(0,))
 
     def _zero_stats(self):
         # transport counters (module docstring; asserted by the CPU
@@ -411,16 +453,21 @@ class ContinuousBatcher:
         # behind it issued AFTER the next segment's dispatch
         self.stats = {"segments": 0, "fetches": 0, "fetches_overlapped": 0,
                       "prefill_calls": 0, "prefill_rows": 0,
-                      # fault-tolerance counters: faults observed (chaos
-                      # or real), sessions reconstructed, rows
-                      # re-prefilled by reconstruction waves, wall time
-                      # spent rebuilding (serve_lifecycle / DESIGN.md
-                      # "Serving under failure")
+                      # fault-tolerance counters (serve_lifecycle /
+                      # DESIGN.md "Serving under failure")
                       "faults": 0, "reconstructions": 0,
-                      "reconstruction_rows": 0, "recovery_s": 0.0}
+                      "reconstruction_rows": 0, "recovery_s": 0.0,
+                      # prefix-cache counters: admissions that attached,
+                      # tokens attached instead of re-prefilled (the
+                      # compute the cache saved), copy-on-write block
+                      # copies, and the pool's peak allocated fraction
+                      "prefix_hits": 0, "cached_prefix_tokens": 0,
+                      "prefill_tokens_saved": 0, "cow_copies": 0,
+                      "block_pool_occupancy": 0.0}
         self.last_slot_leaks = 0   # rows still owned at serve() exit
-                                   # (must be 0 — asserted by tests and
-                                   # the chaos bench smoke)
+        self.last_block_leaks = 0  # pool refs unaccounted at serve() exit
+                                   # (both must be 0 — asserted by tests
+                                   # and the bench smokes)
         # row-tick attribution for the bench's waste_breakdown: useful
         # tokens = planned_ticks - tail (tail = post-eos + budget
         # rounding); parked ticks split by whether work was waiting
@@ -432,18 +479,18 @@ class ContinuousBatcher:
                 else contextlib.nullcontext())
 
     def reset(self):
-        """Fresh session on the SAME compiled programs: zero the caches,
-        masks, counters and stats and rewind every row. Lets a caller
-        (the serve bench; a long-running server) run many sessions while
-        paying trace+compile once — the jitted pieces are per-instance
-        closures, so a new ContinuousBatcher would recompile. (With
-        per-row positions rows recycle in place, so this is hygiene
-        between WORKLOADS, not a horizon requirement.)"""
+        """Fresh session on the SAME compiled programs: zero the pool,
+        free every block, drop the radix cache and rewind every row.
+        Lets a caller (the serve bench; a long-running server) run many
+        sessions while paying trace+compile once."""
+        if self._radix is not None:
+            self._radix.clear()
+        self._pool.reset()
+        self._tables[:] = BlockPool.TRASH
         self._caches = jax.tree.map(jnp.zeros_like, self._caches)
-        self._slot_mask = jnp.zeros_like(self._slot_mask)
         self._cur_tok = jnp.zeros_like(self._cur_tok)
         self._n_logical = jnp.zeros_like(self._n_logical)
-        self._row_pos = [self.Tb - 1] * self.B
+        self._row_pos = [0] * self.B
         self._temp[:] = 0.0
         self._topk[:] = 0
         self._topp[:] = 2.0
@@ -453,67 +500,64 @@ class ContinuousBatcher:
 
     # ---- compiled pieces -------------------------------------------------
 
-    def _admit_impl(self, params, caches, slot_mask, rows, prompt, pmask,
+    def _admit_impl(self, params, caches, tables, prompt, pmask, positions,
+                    prefix_mask, blk_idx, off_idx,
                     moe_capacity=None, moe_capacity_rows=None):
-        """Prefill an admission WAVE: ``K`` requests' tokens-but-the-last
-        (``prompt``/``pmask`` ``[K, prompt_buf]``, left-padded: an
-        n-token head occupies slots ``prompt_buf - n .. prompt_buf - 1``)
-        into cache rows ``rows [K]``, each at the row's own window
-        ``[0, prompt_buf)`` — ONE compiled forward for the whole wave.
+        """Prefill an admission WAVE into the block pool: ``K`` requests'
+        UNSHARED suffix tokens (``prompt``/``pmask`` ``[K, ws]``, laid
+        out from column 0 — an n-token suffix occupies columns
+        ``0..n-1``), each row's token ``t`` at LOGICAL position
+        ``positions[j, t] = m_j + t`` (``m_j`` = the row's cached-prefix
+        length, 0 with the prefix cache off) — ONE compiled forward for
+        the whole wave.
+
+        When the wave carries attachments (static ``Lp =
+        prefix_mask.shape[1] > 0``), each layer gathers the rows' cached
+        prefix K/V from its pool through ``tables`` and the blocks
+        attend the suffix against it (``kv_prefix`` — the bottom-right-
+        aligned causal mask gives "all prefix + window up to self" for
+        free); ``prefix_mask`` hides table entries past each row's own
+        ``m_j``. The computed suffix K/V scatter to their physical
+        (block, offset) targets ``blk_idx``/``off_idx`` (out-of-range
+        ids = pad slots, ``mode="drop"``) — pads both for rows shorter
+        than the window and for the rows padding ``K`` up to a
+        batch-axes multiple (an UNEVENLY batch-sharded prefill was
+        observed to miscompile under mixed-axes meshes on this
+        backend).
 
         Each request's LAST prompt token is deliberately NOT prefilled:
         the host sets it as the row's current token and the next
-        segment's first tick consumes it — writing its K/V at slot
-        ``prompt_buf`` and sampling the request's first new token
-        exactly as a standalone ``generate`` would. This keeps admission
-        a pure dispatch (no device->host read — a fetch costs ~130 ms on
-        the relayed-TPU transport, which at serving admission rates
-        would dominate everything; the only fetch in the serve loop is
-        the per-segment token harvest). The window offset is STATIC
-        (always 0): per-row positions removed the old
-        global-position-dependent offset entirely.
-
-        Under a mesh, the wave's K/V (``[2, K, hk, Tb, hd]``, kv heads
-        pinned over ``tensor``) is scattered into the ROW-sharded cache
-        — the layout change IS the scatter's resharding collective, the
-        portable-redistribution move the module docstring names. The
-        host pads ``K`` up to a multiple of the batch-axes product
-        (pad rows carry all-zero masks and an OUT-OF-BOUNDS row index;
-        ``mode="drop"`` discards their writes): an UNEVENLY
-        batch-sharded prefill was observed to miscompile under
-        mixed-axes meshes on this backend (wrong K/V values for a
-        1-row wave on data x expert, CPU SPMD — the same partitioner
-        fragility ``core.mesh.constrain_activations`` documents), and
-        even partitioning keeps it on the well-trodden path.
-
-        The window width is the PROMPT'S OWN (static) width, normally
-        ``prompt_buf`` — but session reconstruction after a device
-        fault re-prefills ``prompt + generated-so-far`` prefixes that
-        can outgrow ``prompt_buf``, at a wider window (each distinct
-        width compiles once, like any other admission shape; see
-        ``_reconstruct``).
+        segment's first tick consumes it — writing its K/V at the
+        row's head length and sampling the first new token exactly as a
+        standalone ``generate`` would. Admission stays a pure dispatch
+        (no device->host read).
         """
+        from distributed_compute_pytorch_tpu.ops.attention import (
+            gather_kv_blocks)
         model = self.model
-        Tb = prompt.shape[1]
-        pad_count = Tb - jnp.sum(pmask.astype(jnp.int32), axis=1)
-        logical = jnp.maximum(jnp.arange(Tb)[None, :] - pad_count[:, None],
-                              0)
-        x = constrain(model.embed(params, prompt, logical),
+        Lp = prefix_mask.shape[1]
+        x = constrain(model.embed(params, prompt, positions),
                       P(("data", "fsdp"), None, None))
         blocks = params["blocks"]
-        kvs = []
+        new_caches = []
         for i in range(self._n_layers):
             p_i = jax.tree.map(lambda a: a[i], blocks)
             sink: list = []
             kw = {"kv_sink": sink, "kv_mask": pmask}
+            if Lp:
+                # attached-prefix K/V: gathered from the pool and
+                # resharded into the row-sharded compute layout (the
+                # portable-redistribution move)
+                pk = gather_kv_blocks(caches[i]["kv"],
+                                      tables[:, :Lp // self.bt])
+                pk = constrain(pk, _CACHE_SPEC)
+                kw["kv_prefix"] = (pk[0], pk[1], prefix_mask)
             if self._block_takes_positions:
-                kw["positions"] = jnp.arange(Tb)   # absolute slots 0..Tb-1
+                kw["positions"] = positions
             if self._block_takes_moe_capacity and moe_capacity is not None:
                 # expert queues sized for each row's REAL token count:
                 # pads route nowhere (kv_mask) and every row is its own
-                # routing group (models/moe.py), so the real tokens see
-                # exactly the standalone prefill's capacity instead of
-                # the window's
+                # routing group (models/moe.py)
                 kw["moe_capacity"] = moe_capacity
                 if (self._block_takes_moe_capacity_rows
                         and moe_capacity_rows is not None):
@@ -521,38 +565,47 @@ class ContinuousBatcher:
             x = self._block.apply(p_i, x, **kw)
             if isinstance(x, tuple):   # MoE blocks return (x, aux)
                 x = x[0]
-            (k, v), = sink             # [K, hk, Tb, hd]
-            kvs.append((k, v))
-        new_caches = []
-        for c, (k, v) in zip(caches, kvs):
-            kv = constrain(jnp.stack([k, v]).astype(c["kv"].dtype),
-                           P(None, None, "tensor", None, None))
-            new_caches.append(
-                {"kv": c["kv"].at[:, rows, :, :Tb, :].set(kv,
-                                                          mode="drop")})
-        # each row's slot validity: the prompt mask inside the window,
-        # open for decode after it — overwriting whatever the row's
-        # previous occupant left (slots beyond the live position are
-        # additionally hidden by the per-row position mask)
-        m = jnp.concatenate(
-            [pmask.astype(jnp.float32),
-             jnp.ones((pmask.shape[0], self.t_max - Tb), jnp.float32)],
-            axis=1)
-        slot_mask = slot_mask.at[rows].set(m, mode="drop")
-        return new_caches, slot_mask
+            (k, v), = sink             # [K, hk, ws, hd] — suffix only
+            kv = jnp.stack([k, v]).astype(caches[i]["kv"].dtype)
+            # scatter each suffix token to its physical (block, offset):
+            # advanced indices at pool axes (1, 3) land broadcast-first,
+            # so the update region is [K, ws, 2, hk, hd]
+            upd = kv.transpose(1, 3, 0, 2, 4)
+            new = caches[i]["kv"].at[:, blk_idx, :, off_idx, :].set(
+                upd, mode="drop")
+            new_caches.append({"kv": constrain(new, _POOL_SPEC)})
+        return new_caches
 
-    def _segment_impl(self, params, caches, slot_mask, tok, n_logical,
+    def _copy_impl(self, caches, src, dst):
+        """Copy-on-write block copies: pool blocks ``src [M]`` duplicated
+        into ``dst [M]`` across every layer, one compiled dispatch per
+        wave. The copy's tail past the attacher's matched length is the
+        donor's (divergent) K/V — never attended (the per-row position
+        mask stops at the live position) and overwritten as the attacher
+        writes its own suffix."""
+        out = []
+        for c in caches:
+            out.append({name: constrain(
+                leaf.at[:, dst].set(leaf[:, src]), _POOL_SPEC)
+                for name, leaf in c.items()})
+        return out
+
+    def _segment_impl(self, params, caches, tables, tok, n_logical,
                       positions0, temp, top_k, top_p, seeds,
                       sampling: bool = False):
-        """``S`` decode ticks for every row at its OWN position
+        """``S`` decode ticks for every row at its OWN logical position
         (``positions0 [B]`` = each row's last written slot); returns the
-        [B, S] next tokens and the carried state. ``sampling`` (static)
-        compiles the per-row sampling path (``infer.sample_rows``) in;
-        greedy-only sessions keep the bare argmax program. Per-tick keys
-        are PRE-SPLIT outside the scan (one vectorised threefry per
-        segment — the in-scan split chain costs more than the tick's
-        math, ``infer.py``), keyed on (row seed, tokens-so-far) so
-        sampled streams are scheduling-invariant."""
+        [B, S] next tokens and the carried state. Each tick's cache op
+        is the PAGED format of ``ops/attention.py::
+        cache_write_and_attend``: the write resolves through ``tables``
+        to one (block, offset) per row, attention reads the row's
+        gathered logical view. Rows not in the dispatch plan arrive with
+        their table swapped for the all-trash row, so their unavoidable
+        writes (the compiled segment ticks all rows) land in the
+        reserved trash block. ``sampling`` (static) compiles the per-row
+        sampling path in; per-tick keys are PRE-SPLIT outside the scan,
+        keyed on (row seed, tokens-so-far) so sampled streams are
+        scheduling- and attachment-invariant."""
         model = self.model
         blocks = params["blocks"]
         if sampling:
@@ -573,9 +626,11 @@ class ContinuousBatcher:
             new_caches = []
             for li in range(self._n_layers):
                 p_l = jax.tree.map(lambda a: a[li], blocks)
-                x, c2 = self._block.decode_step(p_l, x, caches[li], p,
-                                                slot_mask=slot_mask)
-                new_caches.append(_constrain_cache(c2))
+                paged = {**caches[li], "table": tables}
+                x, c2 = self._block.decode_step(p_l, x, paged, p)
+                new_caches.append(
+                    {name: constrain(leaf, _POOL_SPEC)
+                     for name, leaf in c2.items() if name != "table"})
             logits = model.readout(params, x)[:, -1]
             if sampling:
                 nxt = sample_rows(logits, temp, top_k, top_p, key)
@@ -588,10 +643,65 @@ class ContinuousBatcher:
             (jnp.arange(self.S), tick_keys))
         return caches, tok, n_logical, toks.transpose(1, 0)
 
+    # ---- host block accounting -------------------------------------------
+
+    def _alloc(self, n: int) -> list:
+        """Allocate ``n`` fresh blocks, evicting LRU radix entries first
+        when the free list runs short (eviction frees refcount-0 blocks
+        only, so live rows are never robbed)."""
+        if self._pool.free_count < n and self._radix is not None:
+            self._radix.evict_for(n)
+        return self._pool.alloc(n)
+
+    def _assign_blocks(self, b: int, slot: _Slot, known: list,
+                       remaining: int):
+        """Build row ``b``'s block table for serving ``known`` (prompt,
+        or prompt+generated on reconstruction) with ``remaining`` budget:
+        attach the radix cache's longest prefix (full blocks shared
+        read-only, a partial tail block copy-on-write), allocate fresh
+        blocks for the rest of the row's worst-case extent, and point
+        the table at them. Returns ``(m, cow_pairs)`` — the attached
+        prefix length and the (src, dst) block copies the caller must
+        dispatch BEFORE the wave's prefill."""
+        head = known[:-1]
+        nn = len(head)
+        extent = nn + self._rounded_need(remaining)
+        nblocks = -(-extent // self.bt)
+        m, src = 0, []
+        if self._radix is not None:
+            m, src = self._radix.match(head)
+            m = min(m, nn)
+            src = src[:-(-m // self.bt)] if m else []
+        f, r = divmod(m, self.bt)
+        row_blocks = []
+        for blk in src[:f]:
+            self._pool.acquire(blk)          # shared, read-only
+            row_blocks.append(blk)
+        cow = []
+        if r:
+            dst = self._alloc(1)[0]
+            cow.append((src[f], dst))        # partial block: copy-on-write
+            row_blocks.append(dst)
+        row_blocks += self._alloc(nblocks - len(row_blocks))
+        self._tables[b, :] = BlockPool.TRASH
+        self._tables[b, :nblocks] = row_blocks
+        slot.blocks = row_blocks
+        self.stats["block_pool_occupancy"] = max(
+            self.stats["block_pool_occupancy"],
+            self._pool.allocated / self._pool.num_blocks)
+        return m, cow
+
+    def _copy_blocks(self, pairs: list) -> None:
+        """Dispatch one compiled copy for a wave's COW pairs."""
+        src = jnp.asarray([s for s, _ in pairs], jnp.int32)
+        dst = jnp.asarray([d for _, d in pairs], jnp.int32)
+        with self._mesh_ctx():
+            self._caches = self._copy_c(self._caches, src, dst)
+
     # ---- host scheduler --------------------------------------------------
 
     def _rounded_need(self, max_new: int) -> int:
-        """Decode slots a request consumes past ``prompt_buf`` before its
+        """Decode slots a request consumes past its head before its
         row is harvested and freed: the SEGMENT-ROUNDED budget (a row
         runs whole segments; eos can only shorten the output, not the
         worst-case tick count)."""
@@ -692,7 +802,10 @@ class ContinuousBatcher:
         """Fault-tolerant serving: run every request through the pool
         and return a :class:`serve_lifecycle.RequestResult` PER REQUEST
         (in request order) — nothing raises away the call, and no
-        completed work is ever discarded.
+        completed work is ever discarded. Each result carries its
+        ``cached_prefix_tokens`` (how much of its prompt attached to
+        the radix cache instead of re-prefilling; 0 with the cache
+        off).
 
         Per-request lifecycle (``serve_lifecycle`` status vocabulary):
         validation failures and horizon-infeasible budgets come back
@@ -715,10 +828,9 @@ class ContinuousBatcher:
         (``_reconstruct``): live rows are rebuilt token-exactly from
         host-tracked state and decode resumes — bounded by
         ``max_recoveries``, with a newest-admission eviction heuristic
-        when a fault survives reconstruction (a poison row re-poisons
-        every incarnation). ``chaos`` injects faults for drills
-        (:class:`serve_lifecycle.ChaosInjector`); production passes
-        None.
+        when a fault survives reconstruction. ``chaos`` injects faults
+        for drills (:class:`serve_lifecycle.ChaosInjector`); production
+        passes None.
         """
         return self._run(requests, drain=drain,
                          drain_deadline_s=drain_deadline_s, chaos=chaos)
@@ -727,9 +839,9 @@ class ContinuousBatcher:
              drain_deadline_s: float | None = None, chaos=None) -> list:
         """The scheduler engine behind :meth:`serve` and
         :meth:`serve_detailed` — the overlapped dispatch/harvest loop
-        (module docstring) with the request lifecycle, drain protocol
-        and fault recovery threaded through its host-side decision
-        points."""
+        (module docstring) with the request lifecycle, drain protocol,
+        fault recovery and block accounting threaded through its
+        host-side decision points."""
         t0 = time.monotonic()
         with self._cancel_mu:
             self._cancelled.clear()
@@ -737,6 +849,7 @@ class ContinuousBatcher:
         results: list[RequestResult | None] = [None] * n
         ticks_charged = [0] * n
         recs = [0] * n
+        cached_prefix = [0] * n
 
         def fin(i, status, tokens, error=None):
             if results[i] is not None:
@@ -745,7 +858,8 @@ class ContinuousBatcher:
                 status=status, tokens=list(tokens), error=error,
                 ticks=ticks_charged[i],
                 latency_s=time.monotonic() - t0,
-                recoveries=recs[i])
+                recoveries=recs[i],
+                cached_prefix_tokens=cached_prefix[i])
 
         # -- submission: validation failures are structured, not raised
         valid = []
@@ -800,6 +914,16 @@ class ContinuousBatcher:
         draining = {"on": False, "deadline": None}
         fault_state = {"recoveries": 0, "consecutive": 0}
 
+        def free_row(b):
+            """Release row ``b``'s pool references and park its table at
+            trash. Every terminal slot transition funnels here — the
+            block-leak invariant depends on it."""
+            slot = table[b]
+            if slot.blocks:
+                self._pool.release(slot.blocks)
+            self._tables[b, :] = BlockPool.TRASH
+            slot.free()
+
         def police():
             """Host-known lifecycle transitions between device calls:
             drain start (stop admission, shed the queue), cancellations
@@ -826,26 +950,26 @@ class ContinuousBatcher:
                     fin(i, TIMEOUT, [],
                         f"deadline_s={requests[i].deadline_s} expired "
                         f"while queued")
-            for slot in table:
+            for b, slot in enumerate(table):
                 i = slot.req_index
                 if i < 0:
                     continue
                 if i in cancelled:
                     fin(i, CANCELLED, slot.out, "cancelled in flight")
-                    slot.free()
+                    free_row(b)
                 elif deadline_at[i] is not None and now >= deadline_at[i]:
                     fin(i, TIMEOUT, slot.out,
                         f"deadline_s={requests[i].deadline_s} expired "
                         f"in flight")
-                    slot.free()
+                    free_row(b)
             if (draining["on"] and draining["deadline"] is not None
                     and now > draining["deadline"]):
-                for slot in table:
+                for b, slot in enumerate(table):
                     if slot.req_index < 0:
                         continue
                     fin(slot.req_index, CANCELLED, slot.out,
                         f"drain deadline ({drain_deadline_s}s) expired")
-                    slot.free()
+                    free_row(b)
 
         def pick_admissions(k_free: int) -> list[int]:
             take: list[int] = []
@@ -866,16 +990,17 @@ class ContinuousBatcher:
         def admit_wave():
             """ONE multi-row prefill for every pending request that has
             a free row (the batched admission: k admissions, 1 dispatch).
-            All host->device, no fetch."""
+            Radix attach + block allocation + COW copies happen here, on
+            the host, before the wave's device work. All host->device,
+            no fetch."""
             free = [b for b, s in enumerate(table) if s.req_index < 0]
             take = pick_admissions(len(free))
             if not take:
                 return
             rows = free[:len(take)]
-            entries = []
+            entries, cow_all = [], []
             for b, ri in zip(rows, take):
                 req = requests[ri]
-                entries.append((b, list(req.tokens)))
                 self._temp[b] = req.temperature
                 self._topk[b] = req.top_k or 0
                 self._topp[b] = req.top_p if req.top_p is not None else 2.0
@@ -887,9 +1012,33 @@ class ContinuousBatcher:
                 slot.remaining = req.max_new
                 slot.admit_seq = admit_seq[0]
                 admit_seq[0] += 1
-            self._prefill_wave(entries, self.Tb)
+                m, cow = self._assign_blocks(b, slot, list(req.tokens),
+                                             req.max_new)
+                cow_all.extend(cow)
+                cached_prefix[ri] = m
+                if m:
+                    self.stats["prefix_hits"] += 1
+                self.stats["cached_prefix_tokens"] += m
+                self.stats["prefill_tokens_saved"] += m
+                entries.append((b, list(req.tokens), m))
+            self.stats["cow_copies"] += len(cow_all)
+            if cow_all:
+                self._copy_blocks(cow_all)
+            self._prefill_wave(entries)
             self.stats["prefill_calls"] += 1
             self.stats["prefill_rows"] += len(take)
+            if self._radix is not None:
+                # the wave's freshly-prefilled heads enter the cache so
+                # later arrivals can attach to them (insert AFTER the
+                # prefill dispatch: device order makes the blocks valid
+                # before any attacher's wave can read them)
+                for b, known, m in entries:
+                    head = known[:-1]
+                    if head:
+                        nb_head = -(-len(head) // self.bt)
+                        self._radix.insert(
+                            head, [int(x) for x in
+                                   self._tables[b, :nb_head]])
 
         def dispatch_segment():
             """Dispatch ONE compiled segment (no fetch). Returns the
@@ -898,9 +1047,10 @@ class ContinuousBatcher:
             is applied HERE, at dispatch — it is host-known — so the
             overlapping caller can decide about segment N+1 without
             waiting for segment N's tokens; rows that are done (or
-            free) are parked at the window edge, where their garbage
-            writes stay inside [Tb, Tb + S) (in range because any
-            admission implies Tb + S <= t_max)."""
+            free) are parked at position 0 with their table swapped for
+            the all-trash row, so their garbage writes land in the
+            reserved trash block and can never touch a live or cached
+            block."""
             plan = []
             for b, slot in enumerate(table):
                 if slot.req_index >= 0 and slot.remaining > 0:
@@ -912,16 +1062,18 @@ class ContinuousBatcher:
             pending = (bool(queue) if self.admit_policy == "fifo"
                        else any(self._fits(requests[i]) for i in queue))
             active = {b for b, _, _, _ in plan}
+            tables_now = self._tables.copy()
             for b in range(self.B):
                 if b not in active:
-                    self._row_pos[b] = self.Tb - 1
+                    tables_now[b, :] = BlockPool.TRASH
+                    self._row_pos[b] = 0
                     key = ("parked_admission_lag" if pending
                            else "parked_drain")
                     self.waste[key] += self.S
             with self._mesh_ctx():
                 (self._caches, self._cur_tok, self._n_logical, toks
                  ) = self._segment_c(
-                    self.params, self._caches, self._slot_mask,
+                    self.params, self._caches, jnp.asarray(tables_now),
                     self._cur_tok, self._n_logical,
                     jnp.asarray(self._row_pos, jnp.int32),
                     jnp.asarray(self._temp), jnp.asarray(self._topk),
@@ -981,7 +1133,7 @@ class ContinuousBatcher:
                     done = True
                 if done:
                     fin(ri, OK, slot.out)
-                    slot.free()
+                    free_row(b)
 
         def handle_fault(e: BaseException) -> bool:
             """A device interaction failed (raised or hung). Recover by
@@ -999,27 +1151,28 @@ class ContinuousBatcher:
             if fault_state["recoveries"] >= self.max_recoveries:
                 msg = (f"device lost after {fault_state['recoveries']} "
                        f"recovery attempt(s) ({err})")
-                for slot in table:
+                for b, slot in enumerate(table):
                     if slot.req_index >= 0:
                         fin(slot.req_index, FAILED, slot.out, msg)
-                        slot.free()
+                        free_row(b)
                 for i in list(queue):
                     fin(i, FAILED, [], msg)
                 queue.clear()
                 return False
             fault_state["recoveries"] += 1
             if fault_state["consecutive"] >= 2:
-                live = [s for s in table if s.req_index >= 0]
+                live = [b for b, s in enumerate(table) if s.req_index >= 0]
                 if live:
-                    victim = max(live, key=lambda s: s.admit_seq)
-                    fin(victim.req_index, FAILED, victim.out,
+                    victim = max(live, key=lambda b: table[b].admit_seq)
+                    fin(table[victim].req_index, FAILED,
+                        table[victim].out,
                         f"evicted as suspected poison row after "
                         f"repeated faults ({err})")
-                    victim.free()
+                    free_row(victim)
             for slot in table:
                 if slot.req_index >= 0:
                     recs[slot.req_index] += 1
-            self._reconstruct(table, requests, fin)
+            self._reconstruct(table, requests, fin, free_row)
             self.stats["reconstructions"] += 1
             self.stats["recovery_s"] += time.monotonic() - t_fault
             return True
@@ -1060,101 +1213,149 @@ class ContinuousBatcher:
         # slot-accounting invariant: every row must be free at exit —
         # a leak means a cancelled/failed row kept its slot (tests and
         # the chaos bench smoke assert last_slot_leaks == 0)
-        leaked = [s for s in table if s.req_index >= 0
+        leaked = [b for b, s in enumerate(table) if s.req_index >= 0
                   and results[s.req_index] is None]
         self.last_slot_leaks = len(leaked)
-        for s in leaked:
-            fin(s.req_index, FAILED, s.out, "slot leak (scheduler bug)")
-            s.free()
+        for b in leaked:
+            fin(table[b].req_index, FAILED, table[b].out,
+                "slot leak (scheduler bug)")
+            free_row(b)
+        for b, s in enumerate(table):
+            if s.req_index >= 0:
+                free_row(b)                # finalised elsewhere; release
+        # block-accounting invariant (the PR 5 slot-leak discipline
+        # extended to blocks): with every row freed, the only live pool
+        # references are the radix cache's (and the pinned trash block)
+        held = self._radix.held() if self._radix is not None else {}
+        self.last_block_leaks = self._pool.leak_check(held)
+        self.stats["block_pool_occupancy"] = max(
+            self.stats["block_pool_occupancy"],
+            self._pool.high_water / self._pool.num_blocks)
         for i in range(n):
             if results[i] is None:
                 fin(i, FAILED, [], "not served (scheduler bug)")
         return results
 
-    # ---- fault recovery ---------------------------------------------------
+    # ---- admission / recovery waves ---------------------------------------
 
-    def _prefill_wave(self, entries, window: int):
+    def _prefill_wave(self, entries, window: int | None = None):
         """ONE compiled multi-row prefill of ``entries`` ``(row,
-        known_tokens)`` at a static ``window`` width: every entry's
-        tokens-but-the-last land left-padded in its row's window, the
-        last becomes the row's current token, and the row rewinds to
-        ``window - 1`` (``_admit_impl``). Shared by admission waves
-        (``window == prompt_buf``) and reconstruction waves (``window``
-        sized to the grown prefix). Pure dispatch — no fetch."""
-        K = len(entries)
-        # pad the wave to a multiple of the batch-axes product: pad
-        # rows are all-masked and scatter OUT OF BOUNDS (dropped) —
-        # see _admit_impl's partitioner note; off-mesh _dp == 1
-        Kp = -(-K // self._dp) * self._dp
-        prompt = np.zeros((Kp, window), np.int32)
-        pmask = np.zeros((Kp, window), np.float32)
-        lasts = np.zeros((K,), np.int32)
-        n_log = np.zeros((K,), np.int32)
-        caps = []
-        rows = [b for b, _ in entries]
-        for j, (b, known) in enumerate(entries):
-            # prefill all but the last token; the next segment's first
-            # tick consumes that one (_admit_impl)
-            head, lasts[j] = known[:-1], known[-1]
-            nn = len(head)
-            n_log[j] = nn
-            if nn:
-                prompt[j, window - nn:] = head
-                pmask[j, window - nn:] = 1.0
-            if self._block_takes_moe_capacity:
-                caps.append(self._block.prefill_capacity(len(known)))
-        kw = {}
-        if caps:
-            kw["moe_capacity"] = max(caps)
-            if self._block_takes_moe_capacity_rows:
-                kw["moe_capacity_rows"] = jnp.asarray(
-                    caps + [1] * (Kp - K), jnp.int32)
-        rows_j = jnp.asarray(rows, jnp.int32)
-        rows_pad = jnp.asarray(rows + [self.B] * (Kp - K), jnp.int32)
-        with self._mesh_ctx():
-            self._caches, self._slot_mask = self._admit_c(
-                self.params, self._caches, self._slot_mask, rows_pad,
-                jnp.asarray(prompt), jnp.asarray(pmask), **kw)
-            self._cur_tok = self._cur_tok.at[rows_j].set(
-                jnp.asarray(lasts))
-            self._n_logical = self._n_logical.at[rows_j].set(
-                jnp.asarray(n_log))
-        for b, _ in entries:
-            self._row_pos[b] = window - 1    # the row's own horizon
+        known_tokens, cached_prefix_m)``: every entry's unshared SUFFIX
+        (tokens past its attached prefix, minus the last token) lands
+        from column 0 of a static ``window``-wide batch and scatters
+        into the row's table-mapped blocks; the last known token becomes
+        the row's current token and the row rewinds to ``head_len - 1``.
 
-    def _reconstruct(self, table, requests, fin) -> None:
+        ``window`` defaults to ``prompt_buf`` when no entry attaches
+        (the one stable admission shape, exactly the pre-paged compile
+        behaviour) and to the block-rounded longest suffix otherwise;
+        reconstruction passes the width its grown prefixes need. Rows
+        whose head is fully cached contribute zero suffix tokens — a
+        wave that is ALL attach skips the device prefill entirely (the
+        block lookup IS the admission). Pure dispatch — no fetch."""
+        suffixes = [len(known) - 1 - m for _, known, m in entries]
+        max_m = max(m for _, _, m in entries)
+        if window is None:
+            window = (self.Tb if max_m == 0 else
+                      max(self.bt,
+                          -(-max(suffixes) // self.bt) * self.bt))
+        Lp = -(-max_m // self.bt) * self.bt
+        rows = [b for b, _, _ in entries]
+        lasts = [known[-1] for _, known, _ in entries]
+        n_log = [len(known) - 1 for _, known, _ in entries]
+        if max(suffixes) > 0:
+            K = len(entries)
+            # pad the wave to a multiple of the batch-axes product: pad
+            # rows are all-masked and their scatter targets are OUT OF
+            # BOUNDS (dropped) — see _admit_impl's partitioner note;
+            # off-mesh _dp == 1
+            Kp = -(-K // self._dp) * self._dp
+            P_oob = self._pool.num_blocks
+            prompt = np.zeros((Kp, window), np.int32)
+            pmask = np.zeros((Kp, window), np.float32)
+            positions = np.tile(np.arange(window, dtype=np.int32),
+                                (Kp, 1))
+            prefix_mask = np.zeros((Kp, Lp), np.float32)
+            blk_idx = np.full((Kp, window), P_oob, np.int32)
+            off_idx = np.zeros((Kp, window), np.int32)
+            tables_wave = np.full((Kp, self.nb), BlockPool.TRASH,
+                                  np.int32)
+            caps = []
+            for j, (b, known, m) in enumerate(entries):
+                head = known[:-1]
+                suf = head[m:]
+                sn = len(suf)
+                if sn:
+                    prompt[j, :sn] = suf
+                    pmask[j, :sn] = 1.0
+                positions[j, :] += m
+                if m:
+                    prefix_mask[j, :m] = 1.0
+                tables_wave[j] = self._tables[b]
+                logical = m + np.arange(sn)
+                blk_idx[j, :sn] = self._tables[b][logical // self.bt]
+                off_idx[j, :sn] = logical % self.bt
+                if self._block_takes_moe_capacity:
+                    caps.append(self._block.prefill_capacity(len(known)))
+            kw = {}
+            if caps:
+                kw["moe_capacity"] = max(caps)
+                if self._block_takes_moe_capacity_rows:
+                    kw["moe_capacity_rows"] = jnp.asarray(
+                        caps + [1] * (Kp - K), jnp.int32)
+            with self._mesh_ctx():
+                self._caches = self._admit_c(
+                    self.params, self._caches, jnp.asarray(tables_wave),
+                    jnp.asarray(prompt), jnp.asarray(pmask),
+                    jnp.asarray(positions), jnp.asarray(prefix_mask),
+                    jnp.asarray(blk_idx), jnp.asarray(off_idx), **kw)
+        rows_j = jnp.asarray(rows, jnp.int32)
+        with self._mesh_ctx():
+            self._cur_tok = self._cur_tok.at[rows_j].set(
+                jnp.asarray(lasts, jnp.int32))
+            self._n_logical = self._n_logical.at[rows_j].set(
+                jnp.asarray(n_log, jnp.int32))
+        for (b, known, _m) in entries:
+            self._row_pos[b] = len(known) - 2    # head_len - 1
+
+    def _reconstruct(self, table, requests, fin, free_row) -> None:
         """Device-failure session reconstruction: rebuild every live
-        row's KV cache by re-prefilling ``prompt + generated-so-far``
+        row's KV blocks by re-prefilling ``prompt + generated-so-far``
         from HOST-TRACKED state, then resume decode.
 
         Soundness (DESIGN.md "Serving under failure"): the host knows
         each live row's full token prefix exactly — the prompt plus
         every HARVESTED token — and its true remaining budget.
-        Re-prefilling that prefix reproduces the lost cache's K/V (same
-        params; learned-position models embed logical indices, RoPE
-        scores depend only on within-row slot differences — both
-        preserved at any window offset, the same invariance batched
-        admission already relies on), ``n_logical`` restores to exactly
-        the pre-fault token count, and sampling keys depend only on
-        (seed, tokens-so-far) — so the resumed stream is
-        TOKEN-IDENTICAL to the uninterrupted one, greedy or sampled.
-        Tokens generated but never harvested died with the device
-        buffers and are simply recomputed.
+        Re-prefilling that prefix reproduces the lost K/V (same params;
+        logical positions are laid out identically every time), and
+        sampling keys depend only on (seed, tokens-so-far) — so the
+        resumed stream is TOKEN-IDENTICAL to the uninterrupted one,
+        greedy or sampled. The RADIX CACHE is cleared too: its entries
+        point into the zeroed pool, so trusting them would attach
+        requests to dead K/V. Tokens generated but never harvested died
+        with the device buffers and are simply recomputed.
 
         Rows whose grown prefix no longer fits the per-row horizon
         (window + segment-rounded remaining > t_max) cannot be rebuilt
-        and are finalised ``failed`` WITH their partial stream (size
-        t_max above the workload's minimum for fault-tolerance
-        headroom). Rows re-prefill in waves grouped by window width;
-        each distinct width compiles once, like any admission shape.
+        and are finalised ``failed`` WITH their partial stream. Rows
+        re-prefill in waves grouped by window width; each distinct
+        width compiles once, like any admission shape.
         """
-        # fresh device state on the SAME compiled programs (reset()'s
-        # move): the old buffers are untrusted after a fault
+        # fresh device + host pool state on the SAME compiled programs:
+        # the old buffers are untrusted after a fault. Order matters —
+        # the radix releases its refs into the pool before the pool
+        # resets, and slots drop their (now-dead) block lists without
+        # releasing them twice.
+        if self._radix is not None:
+            self._radix.clear()
+        for slot in table:
+            slot.blocks = []
+        self._pool.reset()
+        self._tables[:] = BlockPool.TRASH
         self._caches = jax.tree.map(jnp.zeros_like, self._caches)
-        self._slot_mask = jnp.zeros_like(self._slot_mask)
         self._cur_tok = jnp.zeros_like(self._cur_tok)
         self._n_logical = jnp.zeros_like(self._n_logical)
-        self._row_pos = [self.Tb - 1] * self.B
+        self._row_pos = [0] * self.B
         waves: dict[int, list] = {}
         for b, slot in enumerate(table):
             if slot.req_index < 0:
@@ -1163,8 +1364,9 @@ class ContinuousBatcher:
             known = list(req.tokens) + list(slot.out)
             head = len(known) - 1
             # reuse the admission window when the prefix still fits it
-            # (no new compile); else the next 8-aligned width
-            W = self.Tb if head <= self.Tb else -(-head // 8) * 8
+            # (no new compile); else the next block-aligned width
+            W = (self.Tb if head <= self.Tb
+                 else -(-head // self.bt) * self.bt)
             remaining = req.max_new - len(slot.out)
             if W + self._rounded_need(remaining) > self.t_max:
                 fin(slot.req_index, FAILED, slot.out,
@@ -1172,12 +1374,16 @@ class ContinuousBatcher:
                     f"{self._rounded_need(remaining)} decode slots > "
                     f"t_max={self.t_max} (raise t_max for "
                     f"fault-tolerance headroom)")
-                slot.free()
+                free_row(b)
                 continue
             waves.setdefault(W, []).append((b, slot, known, remaining))
         for W, rows in sorted(waves.items()):
-            self._prefill_wave([(b, known) for b, _, known, _ in rows],
-                               W)
+            for b, slot, known, remaining in rows:
+                # the radix was cleared, so these allocations are always
+                # fresh blocks (m == 0) — replay never trusts dead K/V
+                self._assign_blocks(b, slot, known, remaining)
+            self._prefill_wave([(b, known, 0)
+                                for b, _, known, _ in rows], W)
             for b, slot, known, remaining in rows:
                 # host-known truth: the in-flight plan's budget
                 # decrement died with the old buffers

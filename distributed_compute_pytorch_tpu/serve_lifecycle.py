@@ -82,7 +82,11 @@ class RequestResult:
     eos is excluded); ``latency_s`` is wall time from submission to the
     terminal event; ``recoveries`` counts how many session
     reconstructions this request's row lived through (0 on a clean
-    run)."""
+    run); ``cached_prefix_tokens`` is how many prompt tokens ATTACHED
+    to the radix prefix cache instead of re-running prefill (0 with
+    the cache off — the paged KV pool's per-request observability,
+    surfaced as ``"cached_prefix"`` on every ``dcp-serve`` output
+    line)."""
 
     status: str = OK
     tokens: list = field(default_factory=list)
@@ -90,6 +94,7 @@ class RequestResult:
     ticks: int = 0
     latency_s: float = 0.0
     recoveries: int = 0
+    cached_prefix_tokens: int = 0
 
     @property
     def ok(self) -> bool:

@@ -1,0 +1,287 @@
+"""Paged KV-cache block pool (kv_pool.py + the ops-layer paged cache
+format): host-side alloc/free/refcount/COW/eviction discipline, the
+radix prefix tree's longest-prefix contract, kernel/fallback parity for
+the pool write, paged-vs-dense attention equivalence for both cache
+forms, and reconstruction-after-fault with shared blocks.
+
+Kept CPU-cheap (tier-1 budget note in ROADMAP): everything except the
+one reconstruction drill is host logic or tiny-array jit."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from distributed_compute_pytorch_tpu.kv_pool import (
+    BlockPool, PoolExhausted, RadixCache)
+from distributed_compute_pytorch_tpu.ops.attention import (
+    cache_write_and_attend, gather_kv_blocks)
+from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
+    kv_pool_insert_all, kv_pool_insert_rows_pallas)
+
+
+# ------------------------------------------------------------ BlockPool
+
+
+def test_pool_alloc_release_refcount():
+    pool = BlockPool(6)
+    assert pool.free_count == 5            # trash block reserved
+    a, b = pool.alloc(2)
+    assert pool.ref[a] == pool.ref[b] == 1
+    assert pool.allocated == 3             # + trash
+    pool.acquire(a)                        # shared attach
+    pool.release([a])
+    assert pool.ref[a] == 1                # still live via the sharer
+    pool.release([a, b])
+    assert pool.ref[a] == pool.ref[b] == 0
+    assert pool.free_count == 5
+    assert pool.high_water >= 3
+
+
+def test_pool_exhaustion_and_trash_reserved():
+    pool = BlockPool(4)
+    got = pool.alloc(3)
+    assert BlockPool.TRASH not in got
+    with pytest.raises(PoolExhausted):
+        pool.alloc(1)
+    pool.reset()
+    assert pool.free_count == 3 and pool.ref[BlockPool.TRASH] == 1
+
+
+def test_pool_leak_check():
+    pool = BlockPool(5)
+    a, b = pool.alloc(2)
+    pool.acquire(a)                        # pretend the tree holds a
+    pool.release([a, b])                   # the row frees its refs
+    assert pool.leak_check({a: 1}) == 0    # tree ref accounted
+    assert pool.leak_check({}) == 1        # a's ref now unaccounted
+    pool.release([a])
+    assert pool.leak_check({}) == 0
+
+
+# ------------------------------------------------------------ RadixCache
+
+
+def _pool_and_tree(bt=4, blocks=32):
+    pool = BlockPool(blocks)
+    return pool, RadixCache(pool, bt)
+
+
+def test_radix_insert_match_longest_prefix():
+    pool, tree = _pool_and_tree(bt=4)
+    seq_a = list(range(10))                # blocks cover ceil(10/4) = 3
+    blocks_a = pool.alloc(3)
+    assert tree.insert(seq_a, blocks_a)
+    # exact hit
+    m, blks = tree.match(seq_a)
+    assert m == 10 and blks == blocks_a
+    # strict-prefix query: match ends mid-edge, blocks trim to coverage
+    m, blks = tree.match(seq_a[:6] + [99, 98])
+    assert m == 6 and blks == blocks_a[:2]
+    # divergent branch shares the tree path but keeps its own blocks
+    seq_b = seq_a[:6] + [50, 51, 52]
+    blocks_b = pool.alloc(3)
+    assert tree.insert(seq_b, blocks_b)
+    m, blks = tree.match(seq_b)
+    assert m == 9 and blks == blocks_b
+    # a miss at the first token
+    assert tree.match([77, 78]) == (0, [])
+    # duplicate insert acquires nothing, refreshes LRU
+    assert not tree.insert(seq_a, blocks_a)
+    assert pool.ref[blocks_a[0]] == 2      # alloc + one tree ref
+
+
+def test_radix_eviction_lru_and_live_blocks_survive():
+    pool, tree = _pool_and_tree(bt=4, blocks=8)   # 7 usable
+    a = pool.alloc(2)
+    tree.insert(list(range(8)), a)
+    b = pool.alloc(2)
+    tree.insert([9, 9] + list(range(6)), b)
+    pool.release(a)
+    pool.release(b)                        # rows done; tree-only refs
+    tree.match([9, 9])                     # refresh b: a becomes LRU
+    free0 = pool.free_count
+    assert free0 == 3
+    # a live row still shares a's first block — eviction drops the
+    # entry but only the refcount-0 block actually frees
+    pool.acquire(a[0])
+    tree.evict_for(free0 + 1)     # one entry's worth of pressure
+    assert pool.ref[a[0]] == 1 and pool.ref[a[1]] == 0
+    assert tree.match(list(range(8)))[0] == 0     # a evicted (LRU)
+    assert tree.match([9, 9])[0] > 0              # b survives
+    # held() reflects the surviving entry only
+    held = tree.held()
+    assert set(held) == set(b)
+    tree.clear()
+    pool.release([a[0]])
+    assert pool.leak_check({}) == 0
+
+
+# ---------------------------------------------- paged pool write parity
+
+
+@pytest.mark.parametrize("form", ["bf16", "int8kv"])
+def test_pool_insert_kernel_matches_scatter(form):
+    """The per-row paged write (interpret-mode Pallas kernel) == the
+    XLA scatter fallback == a numpy reference, for both cache forms —
+    including rows sharing the trash block (sequential grid: garbage,
+    never a race) and window-edge offsets."""
+    P_, HK, BT, HD = 6, 3, 32, 64
+    key = jax.random.key(0)
+    shapes = ({"kv": (HD, jnp.bfloat16)} if form == "bf16"
+              else {"kv": (HD, jnp.int8), "scale": (1, jnp.float32)})
+    cache, upd = {}, {}
+    for i, (name, (hd, dt)) in enumerate(shapes.items()):
+        cache[name] = (jax.random.normal(
+            jax.random.fold_in(key, i), (2, P_, HK, BT, hd)) * 40
+        ).astype(dt)
+        upd[name] = (jax.random.normal(
+            jax.random.fold_in(key, 100 + i), (2, 4, HK, 1, hd)) * 40
+        ).astype(dt)
+    blocks = jnp.array([1, 3, 5, 2], jnp.int32)
+    offsets = jnp.array([0, 7, 31, 8], jnp.int32)
+    ref = {n: np.asarray(cache[n]).copy() for n in cache}
+    for n in cache:
+        for b in range(4):
+            ref[n][:, int(blocks[b]), :, int(offsets[b])] = (
+                np.asarray(upd[n])[:, b, :, 0])
+    got_k = jax.jit(lambda c, u, bk, of: kv_pool_insert_rows_pallas(
+        c, u, bk, of, interpret=True))(cache, upd, blocks, offsets)
+    got_s = jax.jit(kv_pool_insert_all)(cache, upd, blocks, offsets)
+    for n in cache:
+        np.testing.assert_array_equal(ref[n], np.asarray(got_k[n]),
+                                      err_msg=f"kernel:{n}")
+        np.testing.assert_array_equal(ref[n], np.asarray(got_s[n]),
+                                      err_msg=f"scatter:{n}")
+
+
+def test_pool_insert_in_scan_traced_positions():
+    """The serving decode pattern: traced per-row (block, offset)
+    advancing inside lax.scan, rows crossing block boundaries at
+    different ticks."""
+    B, HK, BT, HD, P_ = 2, 1, 8, 8, 4
+    cache0 = {"kv": jnp.zeros((2, P_, HK, BT, HD), jnp.float32)}
+    table = np.array([[1, 2], [3, 1]])     # row 1 reuses block 1 later
+    base = jnp.array([6, 0], jnp.int32)    # row 0 crosses into block 2
+
+    @jax.jit
+    def run(cache):
+        def tick(c, i):
+            pos = base + i
+            blk = jnp.asarray(table)[jnp.arange(B), pos // BT]
+            upd = {"kv": jnp.full((2, B, HK, 1, HD), i + 1.0)}
+            return kv_pool_insert_all(c, upd, blk, pos % BT), None
+        out, _ = lax.scan(tick, cache, jnp.arange(4))
+        return out
+
+    out = np.asarray(run(cache0)["kv"])
+    # row 0: slots 6,7 in block 1 then 8,9 -> block 2 offsets 0,1
+    assert (out[:, 1, 0, 6] == 1).all() and (out[:, 1, 0, 7] == 2).all()
+    assert (out[:, 2, 0, 0] == 3).all() and (out[:, 2, 0, 1] == 4).all()
+    # row 1: slots 0..3 in block 3
+    for i in range(4):
+        assert (out[:, 3, 0, i] == i + 1).all()
+
+
+# ------------------------------------------ paged-vs-dense attention
+
+
+def _mk(shape, key, dt=jnp.float32):
+    return jax.random.normal(jax.random.key(key), shape).astype(dt)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_write_and_attend_matches_dense(quant):
+    """The paged cache format of ``cache_write_and_attend`` == the
+    dense per-row format, bit-for-bit: same written K/V (via the
+    gathered logical view) and same attention output, at per-row
+    positions, for the bf16-style and int8 forms."""
+    B, HK, H, T, BT, HD = 2, 2, 4, 16, 8, 64
+    nb, P_ = T // BT, 5
+    table = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    pos = jnp.array([3, 9], jnp.int32)
+    q = _mk((B, H, 1, HD), 0)
+    k = _mk((B, HK, 1, HD), 1)
+    v = _mk((B, HK, 1, HD), 2)
+    if quant:
+        dense = {"kv": (_mk((2, B, HK, T, HD), 3) * 40).astype(jnp.int8),
+                 "scale": jnp.abs(_mk((2, B, HK, T, 1), 4))}
+    else:
+        dense = {"kv": _mk((2, B, HK, T, HD), 3)}
+    # pool holding the SAME logical content as the dense cache
+    pool = {}
+    for name, leaf in dense.items():
+        w = leaf.shape[-1]
+        pl_ = jnp.zeros((2, P_, HK, BT, w), leaf.dtype)
+        for b in range(B):
+            for j in range(nb):
+                pl_ = pl_.at[:, int(table[b, j])].set(
+                    leaf[:, b, :, j * BT:(j + 1) * BT])
+        pool[name] = pl_
+    out_d, new_d = jax.jit(cache_write_and_attend)(q, k, v, dense, pos)
+    out_p, new_p = jax.jit(cache_write_and_attend)(
+        q, k, v, {**pool, "table": table}, pos)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_d),
+                               atol=1e-6)
+    for name in dense:
+        got = np.asarray(gather_kv_blocks(new_p[name], table))
+        np.testing.assert_array_equal(got, np.asarray(new_d[name]),
+                                      err_msg=name)
+    assert "table" in new_p                # format round-trips
+
+
+def test_gather_kv_blocks_layout():
+    pool = jnp.arange(2 * 4 * 1 * 2 * 3).reshape(2, 4, 1, 2, 3)
+    table = jnp.array([[2, 0], [1, 3]])
+    got = np.asarray(gather_kv_blocks(pool, table))
+    assert got.shape == (2, 2, 1, 4, 3)
+    np.testing.assert_array_equal(got[:, 0, :, :2], pool[:, 2])
+    np.testing.assert_array_equal(got[:, 0, :, 2:], pool[:, 0])
+    np.testing.assert_array_equal(got[:, 1, :, :2], pool[:, 1])
+
+
+# -------------------------------- reconstruction with shared blocks
+
+
+def test_reconstruction_after_fault_with_shared_blocks():
+    """A device fault mid-stream while rows SHARE prefix blocks: the
+    radix cache is cleared (its blocks died with the pool), every live
+    row rebuilds from host-tracked state, the resumed streams equal a
+    fault-free run token for token, and neither slots nor blocks
+    leak."""
+    from distributed_compute_pytorch_tpu.models.gpt2 import (
+        GPT2, GPT2Config)
+    from distributed_compute_pytorch_tpu.serve import (
+        ContinuousBatcher, Request)
+    from distributed_compute_pytorch_tpu.serve_lifecycle import (
+        ChaosInjector)
+
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    shared = [int(t) for t in rng.integers(0, 256, 5)]
+    reqs = []
+    for i in range(8):
+        r = Request(shared + [int(t) for t in rng.integers(0, 256, 2)], 8)
+        if i % 4 == 3:                     # sampled rows ride along
+            r.temperature = 0.8
+            r.seed = 100 + i
+        reqs.append(r)
+
+    def clone():
+        return [dataclasses.replace(r) for r in reqs]
+
+    cb = ContinuousBatcher(model, params, slots=4, t_max=64, prompt_buf=8,
+                           segment=4, prefix_cache=True)
+    clean = cb.serve_detailed(clone())
+    assert cb.stats["prefix_hits"] > 0     # blocks genuinely shared
+    cb.reset()
+    chaos = ChaosInjector(fault_at_segment=2, fault_mode="raise")
+    faulted = cb.serve_detailed(clone(), chaos=chaos)
+    assert all(r.ok for r in faulted), [r.status for r in faulted]
+    assert [r.tokens for r in faulted] == [r.tokens for r in clean]
+    assert cb.stats["reconstructions"] == 1
+    assert cb.last_slot_leaks == 0 and cb.last_block_leaks == 0

@@ -181,16 +181,20 @@ def test_long_stream_all_families(name, model):
 
 
 def test_odd_t_max_rounds_to_window_and_matches():
-    """ADVICE r5: an odd t_max (the longest-prompt parity leak from
-    cli_serve's default sizing) must be rounded up to the Pallas
-    cache-window multiple — never silently serve off the fast path —
-    and parity must hold at the rounded shape."""
+    """ADVICE r5, at block granularity: an odd t_max (the longest-prompt
+    parity leak from cli_serve's default sizing) must be rounded up to
+    whole pool blocks — whose size is itself a Pallas cache-window
+    multiple, so serving never silently falls off the window-write fast
+    path — and parity must hold at the rounded shape."""
     model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
     params, _ = model.init(jax.random.key(0))
     cb = ContinuousBatcher(model, params, slots=2, t_max=37, prompt_buf=9,
                            segment=3)
-    assert cb.t_max == 40 and cb.t_max % 8 == 0
-    assert all(c["kv"].shape[3] == 40 for c in cb._caches)
+    assert cb.t_max == 40 and cb.t_max % cb.bt == 0 and cb.bt % 8 == 0
+    assert cb.nb == cb.t_max // cb.bt
+    # the pool's block axis holds every row's worst-case table + trash
+    assert all(c["kv"].shape[1] >= cb.B * cb.nb + 1 for c in cb._caches)
+    assert all(c["kv"].shape[3] == cb.bt for c in cb._caches)
     rng = np.random.default_rng(23)
     reqs = _requests(rng, 5, max_len=9)
     outs = cb.serve([Request(list(r.tokens), r.max_new) for r in reqs])
@@ -525,24 +529,40 @@ def test_moe_admission_capacity_matches_standalone_when_binding():
     rng = np.random.default_rng(11)
     tokens = [int(t) for t in rng.integers(0, 256, 12)]
     head = tokens[:-1]
+    nn = len(head)
     Tb = 16
     cb = ContinuousBatcher(model, params, slots=2, t_max=128,
                            prompt_buf=Tb, segment=3)
+    # one admission wave's arrays, built the way _prefill_wave does —
+    # the paged layout lands the head at logical slots 0..nn-1, mapped
+    # through an explicit block table into pool blocks 1..nb
+    bt, nb = cb.bt, cb.nb
+    row_blocks = np.arange(1, nb + 1, dtype=np.int32)
+    tables = row_blocks[None, :]
     prompt = np.zeros((1, Tb), np.int32)
     pmask = np.zeros((1, Tb), np.float32)
-    prompt[0, Tb - len(head):] = head
-    pmask[0, Tb - len(head):] = 1.0
+    prompt[0, :nn] = head
+    pmask[0, :nn] = 1.0
+    positions = np.tile(np.arange(Tb, dtype=np.int32), (1, 1))
+    prefix_mask = np.zeros((1, 0), np.float32)
+    blk_idx = np.full((1, Tb), cb._pool.num_blocks, np.int32)
+    off_idx = np.zeros((1, Tb), np.int32)
+    logical = np.arange(nn)
+    blk_idx[0, :nn] = row_blocks[logical // bt]
+    off_idx[0, :nn] = logical % bt
 
     def admit(cap):
         caches = jax.tree.map(jnp.zeros_like, cb._caches)
-        sm = jnp.zeros_like(cb._slot_mask)
-        rows = jnp.asarray([0], jnp.int32)
         kw = ({} if cap is None else
               {"moe_capacity": cap,
                "moe_capacity_rows": jnp.asarray([cap], jnp.int32)})
-        return cb._admit_c(cb.params, caches, sm, rows,
-                           jnp.asarray(prompt), jnp.asarray(pmask),
-                           **kw)[0]
+        new = cb._admit_c(cb.params, caches, jnp.asarray(tables),
+                          jnp.asarray(prompt), jnp.asarray(pmask),
+                          jnp.asarray(positions), jnp.asarray(prefix_mask),
+                          jnp.asarray(blk_idx), jnp.asarray(off_idx), **kw)
+        # row 0's logical view over its table: [2, hk, t_max, hd]
+        return [np.asarray(c["kv"][:, row_blocks]).transpose(0, 2, 1, 3, 4)
+                .reshape(2, c["kv"].shape[2], nb * bt, -1) for c in new]
 
     cap = model._block().prefill_capacity(len(tokens))
     assert cap < model._block().prefill_capacity(Tb)   # capacity binds
@@ -555,15 +575,122 @@ def test_moe_admission_capacity_matches_standalone_when_binding():
 
     old_diverges = False
     for li in range(cb._n_layers):
-        solo_kv = np.asarray(solo_caches[li]["kv"])[:, 0, :, :len(head)]
-        new_kv = np.asarray(new_caches[li]["kv"])[:, 0, :,
-                                                  Tb - len(head):Tb]
-        old_kv = np.asarray(old_caches[li]["kv"])[:, 0, :,
-                                                  Tb - len(head):Tb]
+        solo_kv = np.asarray(solo_caches[li]["kv"])[:, 0, :, :nn]
+        new_kv = new_caches[li][:, :, :nn]
+        old_kv = old_caches[li][:, :, :nn]
         np.testing.assert_allclose(new_kv, solo_kv, atol=1e-5)
         old_diverges |= bool(np.abs(old_kv - solo_kv).max() > 1e-3)
     assert old_diverges, ("window-derived capacity routed identically — "
                           "the scenario no longer exercises the fix")
+
+
+# ------------------------------------------------- radix prefix cache
+
+
+def _shared_prefix_requests(rng, n, prefix_len=19, sampled_every=3):
+    """Zipf-ish workload: one hot system prompt (deliberately ending
+    MID-BLOCK so copy-on-write attaches run), short per-request tails,
+    sampled rows riding along."""
+    shared = [int(t) for t in rng.integers(0, 256, prefix_len)]
+    reqs = []
+    for i in range(n):
+        tail = [int(t)
+                for t in rng.integers(0, 256, int(rng.integers(1, 5)))]
+        r = Request(shared + tail, 6)
+        if i % sampled_every == sampled_every - 1:
+            r.temperature = 0.8
+            r.seed = 50 + i
+        reqs.append(r)
+    return reqs
+
+
+@pytest.mark.parametrize("name,model", _models()[:2])   # gpt2 + llama
+def test_prefix_cache_token_parity_greedy_and_sampled(name, model):
+    """THE paged-cache acceptance pin: prefix-cache-ON serving is
+    token-identical to prefix-cache-OFF for greedy AND sampled rows
+    (attachment changes where K/V come from, never a logical position,
+    so the (seed, tokens-generated) key schedule is untouched); greedy
+    rows additionally equal standalone generate; attaches/COW actually
+    happen; nothing leaks."""
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(61)
+    reqs = _shared_prefix_requests(rng, 8)
+    off = ContinuousBatcher(model, params, slots=2, t_max=64,
+                            prompt_buf=24, segment=3)
+    out_off = off.serve(_clone(reqs))
+    on = ContinuousBatcher(model, params, slots=2, t_max=64,
+                           prompt_buf=24, segment=3, prefix_cache=True)
+    results = on.serve_detailed(_clone(reqs))
+    assert [r.tokens for r in results] == out_off, name
+    for req, out in zip(reqs, out_off):
+        if req.temperature > 0:
+            continue
+        solo = generate(model, params,
+                        jnp.asarray([req.tokens], jnp.int32), req.max_new,
+                        t_max=64)
+        assert out == [int(t)
+                       for t in np.asarray(solo)[0, len(req.tokens):]]
+    s = on.stats
+    assert s["prefix_hits"] > 0 and s["prefill_tokens_saved"] > 0
+    assert s["cow_copies"] > 0             # the 19-token prefix ends
+    assert s["cached_prefix_tokens"] == sum(
+        r.cached_prefix_tokens for r in results)   # per-request metadata
+    assert max(r.cached_prefix_tokens for r in results) >= 16
+    assert on.last_slot_leaks == 0 and on.last_block_leaks == 0
+    assert 0 < s["block_pool_occupancy"] <= 1
+
+
+def test_prefix_cache_block_boundary_and_eviction():
+    """Full-block attaches (prefix length an exact block multiple: no
+    COW needed, blocks shared read-only) stay exact, and a stream too
+    big for the configured pool evicts LRU entries instead of failing —
+    with zero leaks either way."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(67)
+    shared = [int(t) for t in rng.integers(0, 256, 16)]   # 2 full blocks
+    reqs = [Request(shared + [int(t) for t in rng.integers(0, 256, 3)], 5)
+            for _ in range(6)]
+    # tight pool: the minimum legal size, so caching beyond the live
+    # rows must evict
+    cb = ContinuousBatcher(model, params, slots=2, t_max=40,
+                           prompt_buf=24, segment=5, prefix_cache=True,
+                           pool_blocks=2 * 5 + 1)
+    outs = cb.serve(_clone(reqs))
+    off = ContinuousBatcher(model, params, slots=2, t_max=40,
+                            prompt_buf=24, segment=5)
+    assert outs == off.serve(_clone(reqs))
+    s = cb.stats
+    assert s["prefix_hits"] > 0
+    # shared span = 16 tokens = whole blocks: attaches never copy
+    assert s["cow_copies"] == 0
+    assert cb.last_block_leaks == 0 and cb.last_slot_leaks == 0
+
+
+def test_prefix_cache_invariant_to_scheduling():
+    """Attachment is a data-movement optimisation, not semantics: the
+    cache-on stream is identical across slots/segment schedules (which
+    change WHICH admissions hit the cache)."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(71)
+    reqs = _shared_prefix_requests(rng, 6)
+    outs = []
+    for slots, seg in ((1, 4), (2, 3), (4, 6)):
+        cb = ContinuousBatcher(model, params, slots=slots, t_max=64,
+                               prompt_buf=24, segment=seg,
+                               prefix_cache=True)
+        outs.append(cb.serve(_clone(reqs)))
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_prefix_cache_rejects_moe():
+    cfg = dataclasses.replace(MoETransformerConfig.tiny(), max_seq_len=128)
+    model = MoETransformerLM(cfg)
+    params, _ = model.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ContinuousBatcher(model, params, slots=2, t_max=64, prompt_buf=10,
+                          prefix_cache=True)
 
 
 def test_moe_no_drop_contract_exact_parity():

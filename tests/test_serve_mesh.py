@@ -152,6 +152,40 @@ def test_mesh_serve_moe_expert_parallel(devices8):
     assert not w_in.sharding.is_fully_replicated, w_in.sharding
 
 
+def test_mesh_serve_prefix_cache_parity(devices8):
+    """The radix prefix cache under a sharded pool: attached blocks
+    reshard into the row-sharded compute layout through the admission
+    gather (the portable-redistribution move), and the cache-on stream
+    stays token-identical to the same-mesh cache-off stream AND the
+    same-mesh standalone batch — with real attaches, zero leaks, and
+    the pool's BLOCK axis genuinely sharded."""
+    model = LlamaLM(dataclasses.replace(LlamaConfig.tiny(),
+                                        max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    mesh = make_mesh("data=2", devices=devices8)
+    sharded = _sharded(model, params, mesh)
+    rng = np.random.default_rng(13)
+    shared = [int(t) for t in rng.integers(0, 256, 11)]
+    reqs = [Request(shared + [int(t) for t in rng.integers(0, 256, 2)],
+                    int(rng.integers(3, 6))) for _ in range(8)]
+    off = ContinuousBatcher(model, sharded, slots=2, t_max=64,
+                            prompt_buf=14, segment=3, mesh=mesh)
+    out_off = off.serve([Request(list(r.tokens), r.max_new)
+                         for r in reqs])
+    on = ContinuousBatcher(model, sharded, slots=2, t_max=64,
+                           prompt_buf=14, segment=3, mesh=mesh,
+                           prefix_cache=True)
+    out_on = on.serve([Request(list(r.tokens), r.max_new) for r in reqs])
+    assert out_on == out_off
+    want = _solo_batch(model, sharded, mesh, reqs)
+    for i, (out, w) in enumerate(zip(out_on, want)):
+        assert out == w, (i, out, w)
+    assert on.stats["prefix_hits"] > 0
+    assert on.stats["cow_copies"] > 0      # 11-token prefix ends mid-block
+    assert on.last_slot_leaks == 0 and on.last_block_leaks == 0
+    _assert_cache_sharded(on, want_tensor=False)
+
+
 def test_mesh_serve_validation(devices8):
     model = LlamaLM(LlamaConfig.tiny())       # 2 kv heads
     params, _ = model.init(jax.random.key(0))
