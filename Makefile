@@ -24,7 +24,8 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke
+.PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
+	serve-load-smoke
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -59,15 +60,24 @@ bench:
 #   prefill_tokens_saved > 0, COW runs, no block/slot leaks, and the
 #   warm-cache admission TTFT proxy is not degraded; records
 #   prefill-bytes-saved
+# - serve-load: the open-loop Poisson load drill over the telemetry
+#   subsystem (obs/); fails unless goodput > 0 with finite p99 TTFT,
+#   tokens are identical to the unloaded path, no slot/block leaks,
+#   the span trace validates as Chrome-trace JSON, and the disabled-
+#   telemetry record path costs < 1% of a segment wall
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --zero1-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-smoke
 	JAX_PLATFORMS=cpu python bench.py --grad-accum-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
 
 serve-chaos-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
 
 serve-prefix-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
+
+serve-load-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke

@@ -59,6 +59,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 
 def _read_requests(path: str, tok, default_new: int, defaults: dict):
@@ -218,11 +219,35 @@ def main(argv=None) -> int:
     p.add_argument("--poison_request", type=int, default=None,
                    help="request index that deterministically poisons "
                         "its row (with --fault_mode poison)")
+    # --- observability (ISSUE 8, obs/; "Observability" in DESIGN.md) ---
+    p.add_argument("--heartbeat", type=float, default=10.0,
+                   help="seconds between heartbeat lines on stderr: one "
+                        "JSON stats_snapshot() per interval (queue "
+                        "depth, SLO percentiles, waste counters) while "
+                        "the serve loop runs; 0 disables")
+    p.add_argument("--metrics_jsonl", type=str, default=None,
+                   help="append heartbeat snapshots and the final "
+                        "stats_snapshot() to this JSONL file")
+    p.add_argument("--trace_path", type=str, default=None,
+                   help="write a Chrome-trace JSON of host-side spans "
+                        "(admit/dispatch/harvest/reconstruct) here at "
+                        "exit; load in Perfetto")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="XLA profiler traces: alone, profiles the whole "
+                        "serve run (utils.timing.maybe_profile); with "
+                        "--profile_segments, arms on-demand profiling "
+                        "instead")
+    p.add_argument("--profile_segments", type=int, default=None,
+                   help="profile the next N dispatched segments into "
+                        "--profile_dir, starting now; SIGUSR1 re-arms "
+                        "the same window on demand mid-run")
     p.add_argument("--force-cpu", action="store_true", dest="force_cpu")
     args = p.parse_args(argv)
 
     if args.max_new_tokens < 1:
         raise SystemExit("--max_new_tokens must be >= 1")
+    if args.profile_segments is not None and args.profile_dir is None:
+        raise SystemExit("--profile_segments needs --profile_dir")
     if args.temperature == 0.0 and (args.top_k is not None
                                     or args.top_p is not None):
         raise SystemExit("--top_k/--top_p require --temperature > 0")
@@ -286,6 +311,21 @@ def main(argv=None) -> int:
         t_max = prompt_buf + max(-(-r["max_new"] // S) * S for r in reqs)
     else:
         t_max = args.t_max
+    from distributed_compute_pytorch_tpu.obs.tracing import (
+        Tracer, configure_tracer)
+    tracer = Tracer() if args.trace_path else None
+    if tracer is not None:
+        configure_tracer(tracer)
+    metrics_f = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
+
+    def on_heartbeat(snap):
+        line = json.dumps({"kind": "serve_heartbeat", "ts": time.time(),
+                           **snap})
+        print(line, file=sys.stderr, flush=True)
+        if metrics_f is not None:
+            metrics_f.write(line + "\n")
+            metrics_f.flush()
+
     cb = ContinuousBatcher(model, params, slots=args.slots, t_max=t_max,
                            prompt_buf=prompt_buf, segment=args.segment,
                            eos_id=args.eos_id, mesh=mesh,
@@ -294,7 +334,21 @@ def main(argv=None) -> int:
                            tick_timeout_s=args.tick_timeout,
                            max_recoveries=args.max_recoveries,
                            kv_block_tokens=args.kv_block_tokens,
-                           prefix_cache=args.prefix_cache)
+                           prefix_cache=args.prefix_cache,
+                           heartbeat_s=args.heartbeat or None,
+                           on_heartbeat=(on_heartbeat if args.heartbeat
+                                         else None))
+
+    if args.profile_segments is not None:
+        # on-demand window (first N segments now; SIGUSR1 re-arms). The
+        # whole-run maybe_profile below stays off in this mode — the two
+        # would fight over one jax.profiler trace session.
+        import signal
+        cb.profile_next(args.profile_segments, args.profile_dir)
+        signal.signal(
+            signal.SIGUSR1,
+            lambda *_: cb.profile_next(args.profile_segments,
+                                       args.profile_dir))
 
     def req_seed(i, r):
         if r["seed"] is not None:
@@ -309,17 +363,33 @@ def main(argv=None) -> int:
                               fault_mode=args.fault_mode,
                               poison_request=args.poison_request)
 
+    from distributed_compute_pytorch_tpu.utils.timing import maybe_profile
+    whole_run_profile = (args.profile_dir
+                         if args.profile_segments is None else None)
     try:
-        results = cb.serve_detailed(
-            [Request(list(r["tokens"]), r["max_new"],
-                     temperature=r["temperature"], top_k=r["top_k"],
-                     top_p=r["top_p"], seed=req_seed(i, r),
-                     deadline_s=r["deadline"])
-             for i, r in enumerate(reqs)],
-            drain=guard, drain_deadline_s=args.drain_deadline,
-            chaos=chaos)
+        with maybe_profile(whole_run_profile):
+            try:
+                results = cb.serve_detailed(
+                    [Request(list(r["tokens"]), r["max_new"],
+                             temperature=r["temperature"], top_k=r["top_k"],
+                             top_p=r["top_p"], seed=req_seed(i, r),
+                             deadline_s=r["deadline"])
+                     for i, r in enumerate(reqs)],
+                    drain=guard, drain_deadline_s=args.drain_deadline,
+                    chaos=chaos)
+            finally:
+                guard.__exit__()
     finally:
-        guard.__exit__()
+        # telemetry flushes on EVERY exit path (drain, fault, Ctrl-C x2)
+        if metrics_f is not None:
+            metrics_f.write(json.dumps({"kind": "serve_final",
+                                        "ts": time.time(),
+                                        **cb.stats_snapshot()}) + "\n")
+            metrics_f.close()
+        if tracer is not None:
+            configure_tracer(None)
+            tracer.dump(args.trace_path)
+            tracer.close()
     for r, res in zip(reqs, results):
         rec = {"prompt": r["tokens"], "new": res.tokens,
                "status": res.status,

@@ -169,6 +169,18 @@ class Config:
                                      # persistent XLA compile cache (skip
                                      # recompiles across restarts/relaunches)
     profile_dir: str | None = None   # opt-in XLA profiler traces (SURVEY §5.1)
+    # --- telemetry (ISSUE 8, obs/): machine-readable metrics + host traces
+    metrics_jsonl: str | None = None  # MetricLogger JSONL sink (train/eval/
+                                      # epoch lines + telemetry records)
+    trace_path: str | None = None     # host span trace: Chrome-trace JSON
+                                      # written here at exit (obs/tracing.py;
+                                      # data-wait/step/eval/checkpoint spans)
+    collective_stats: bool = False    # one-time jaxpr census of the train
+                                      # step's gradient collectives into the
+                                      # registry + metrics_jsonl (reuses
+                                      # parallel.collectives.
+                                      # grad_collective_stats; costs one
+                                      # extra trace at startup)
 
     # --- eval behaviour: reference evaluates on the TRAIN set (main.py:130, bug §A.1).
     # We default to the test split but keep the knob for log-comparison runs.
@@ -351,6 +363,18 @@ class Config:
                        help="persistent XLA compile cache directory "
                             "(env DCP_COMPILE_CACHE)")
         p.add_argument("--profile_dir", type=str, default=None)
+        p.add_argument("--metrics_jsonl", type=str, default=None,
+                       help="append machine-readable metric records "
+                            "(train/eval/epoch lines, device-memory and "
+                            "collective telemetry) to this JSONL file")
+        p.add_argument("--trace_path", type=str, default=None,
+                       help="write a Chrome-trace JSON of host-side spans "
+                            "(data-wait/train_step/eval/checkpoint) here "
+                            "at exit; load in Perfetto")
+        p.add_argument("--collective_stats", action="store_true",
+                       help="trace the train step once at startup and "
+                            "record its gradient-collective op/byte "
+                            "census to the registry and --metrics_jsonl")
         p.add_argument("--eval_on_train", action="store_true",
                        help="replicate reference bug §A.1 (eval on train split)")
         return p

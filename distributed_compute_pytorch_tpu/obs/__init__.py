@@ -1,0 +1,35 @@
+"""Telemetry substrate shared by train and serve (ISSUE 8 / ROADMAP 3).
+
+Three small host-side layers, none of which touch compiled code:
+
+- :mod:`.metrics` — a thread-safe registry of counters, gauges and
+  fixed-log-bucket histograms (p50/p90/p99 without storing samples).
+  ``ContinuousBatcher.stats``/``.waste`` are dict-compatible VIEWS over
+  a per-batcher registry; the SLO histograms (queue-wait, TTFT, TPOT,
+  e2e) live beside them and ``stats_snapshot()`` serialises the lot.
+- :mod:`.tracing` — nestable ``span("admit_wave")`` context managers
+  emitting Chrome-trace-event JSON (Perfetto-loadable) plus an optional
+  JSONL sink, instrumented through the serve scheduler's decision
+  points and the trainer's data-wait/step/eval/checkpoint phases.
+- :mod:`.loadgen` — the open-loop Poisson load harness behind
+  ``bench.py --serve-load-smoke`` (the ROADMAP-3 load generator).
+
+The whole layer is a no-op when disabled (``metrics.set_enabled(False)``
+or ``DCP_TELEMETRY=0``): record paths return before taking any lock and
+``span()`` hands back a shared null context — the disabled cost is one
+global read per call site (the <1% guard in ``tests/test_obs.py``).
+The ``stats``/``waste`` views stay live even when telemetry is off:
+they are functional scheduler counters, not optional diagnostics.
+"""
+
+from distributed_compute_pytorch_tpu.obs import loadgen, metrics, tracing
+from distributed_compute_pytorch_tpu.obs.metrics import (
+    Counter, Gauge, Histogram, MetricDict, Registry, enabled, set_enabled)
+from distributed_compute_pytorch_tpu.obs.tracing import (
+    Tracer, configure_tracer, current_tracer, span)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricDict", "Registry",
+    "Tracer", "configure_tracer", "current_tracer", "enabled",
+    "loadgen", "metrics", "set_enabled", "span", "tracing",
+]

@@ -1,0 +1,203 @@
+"""Host-side span tracing — Chrome-trace-event JSON, Perfetto-loadable.
+
+The serve scheduler interleaves admit/dispatch/harvest/reconstruct
+decisions with overlapped device work; the trainer interleaves
+data-wait/step/eval/checkpoint. A mean timer cannot show WHERE a slow
+tick went — a trace of nested spans can, and the Chrome trace-event
+format (`"ph": "B"/"E"` pairs per thread, microsecond ``ts``) gets us
+the Perfetto UI for free.
+
+Design points:
+
+- Spans are plain objects, not generator context managers: entering a
+  span appends one ``B`` event, exiting one ``E`` event, each a small
+  dict on an in-memory list under a lock. Nesting is implicit in the
+  B/E ordering per ``tid`` (``threading.get_native_id``), so spans
+  opened in the scheduler thread and the watchdogged fetch worker
+  interleave correctly in the same trace.
+- Timestamps come from ``time.perf_counter_ns`` relative to the
+  tracer's epoch — monotonic by construction (the validity property
+  ``tests/test_obs.py`` and the load smoke assert).
+- ``dump(path)`` writes the standard ``{"traceEvents": [...]}`` object;
+  an optional ``jsonl_path`` streams each completed event as a line at
+  span exit (crash-durable, machine-tailable).
+- The module-level :func:`span` uses the installed global tracer and
+  hands back a shared null context when there is none (or telemetry is
+  disabled): instrumented code pays one global read when tracing is
+  off. Install with :func:`configure_tracer`.
+
+Spans measure HOST decision time. JAX dispatch is asynchronous, so a
+``dispatch_segment`` span covers tracing + enqueue, not device
+execution — the XLA profiler (``utils/timing.maybe_profile``,
+``dcp-serve --profile_dir``) owns the device side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from distributed_compute_pytorch_tpu.obs import metrics
+
+
+class _NullSpan:
+    """Shared no-op context for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._tracer._emit("B", self._name, self._args)
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._emit("E", self._name, None)
+        return False
+
+
+class Tracer:
+    """Collects trace events in memory; optionally streams JSONL."""
+
+    def __init__(self, jsonl_path: str | None = None):
+        self._mu = threading.Lock()
+        self._events: list[dict] = []
+        self._epoch_ns = time.perf_counter_ns()
+        self._pid = os.getpid()
+        self._f = open(jsonl_path, "a") if jsonl_path else None
+
+    def _emit(self, ph: str, name: str, args) -> None:
+        ev = {"name": name, "ph": ph, "pid": self._pid,
+              "tid": threading.get_native_id(),
+              "ts": (time.perf_counter_ns() - self._epoch_ns) / 1e3}
+        if args:
+            ev["args"] = args
+        with self._mu:
+            self._events.append(ev)
+            if self._f is not None:
+                self._f.write(json.dumps(ev) + "\n")
+
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker (``ph: "i"`` — drain start, fault)."""
+        ev = {"name": name, "ph": "i", "s": "t", "pid": self._pid,
+              "tid": threading.get_native_id(),
+              "ts": (time.perf_counter_ns() - self._epoch_ns) / 1e3}
+        if args:
+            ev["args"] = args
+        with self._mu:
+            self._events.append(ev)
+            if self._f is not None:
+                self._f.write(json.dumps(ev) + "\n")
+
+    def events(self) -> list[dict]:
+        with self._mu:
+            return list(self._events)
+
+    def dump(self, path: str) -> None:
+        """Write the Perfetto/chrome://tracing-loadable trace object."""
+        with self._mu:
+            events = list(self._events)
+            if self._f is not None:
+                self._f.flush()
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def close(self) -> None:
+        with self._mu:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+_GLOBAL: Tracer | None = None
+
+
+def configure_tracer(tracer: Tracer | None) -> Tracer | None:
+    """Install (or clear, with ``None``) the process-global tracer used
+    by :func:`span`; returns the previous one so tests can restore."""
+    global _GLOBAL
+    prev = _GLOBAL
+    _GLOBAL = tracer
+    return prev
+
+
+def current_tracer() -> Tracer | None:
+    return _GLOBAL
+
+
+def span(name: str, **args):
+    """Module-level span against the global tracer — the form the serve
+    scheduler and trainer call. No tracer (or telemetry disabled) means
+    the shared null context: one global read, zero allocation."""
+    t = _GLOBAL
+    if t is None or not metrics.enabled():
+        return _NULL_SPAN
+    return t.span(name, **args)
+
+
+def instant(name: str, **args) -> None:
+    t = _GLOBAL
+    if t is None or not metrics.enabled():
+        return
+    t.instant(name, **args)
+
+
+def validate_chrome_trace(events: list[dict]) -> list[str]:
+    """Structural validity of a trace-event list: every ``B`` has a
+    matching same-name ``E`` on the same (pid, tid) in LIFO order, and
+    timestamps are monotonically non-decreasing per (pid, tid). Returns
+    the list of violations (empty == valid) — used by the load smoke's
+    trace check and ``tests/test_obs.py``."""
+    problems: list[str] = []
+    stacks: dict = {}
+    last_ts: dict = {}
+    for i, ev in enumerate(events):
+        key = (ev.get("pid"), ev.get("tid"))
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i}: missing/bad ts {ts!r}")
+            continue
+        if key in last_ts and ts < last_ts[key]:
+            problems.append(f"event {i}: ts {ts} < previous "
+                            f"{last_ts[key]} on tid {key}")
+        last_ts[key] = ts
+        ph = ev.get("ph")
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev.get("name"))
+        elif ph == "E":
+            stack = stacks.get(key) or []
+            if not stack:
+                problems.append(f"event {i}: E {ev.get('name')!r} "
+                                f"without open B on tid {key}")
+            else:
+                top = stack.pop()
+                if top != ev.get("name"):
+                    problems.append(
+                        f"event {i}: E {ev.get('name')!r} closes "
+                        f"B {top!r} on tid {key}")
+    for key, stack in stacks.items():
+        if stack:
+            problems.append(f"unclosed span(s) {stack} on tid {key}")
+    return problems

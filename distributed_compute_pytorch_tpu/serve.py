@@ -143,6 +143,19 @@ slot-leak discipline to blocks: after a serve call every pool
 reference must be owned by the radix tree (or the pinned trash block)
 — asserted by tests and the bench smokes alongside
 ``last_slot_leaks``.
+
+Telemetry (ISSUE 8, ``obs/``): ``stats``/``waste`` are dict-compatible
+VIEWS over a per-batcher ``obs.metrics.Registry``; per-request SLO
+histograms (queue-wait, TTFT, TPOT, e2e — measurement points on
+``serve_lifecycle.RequestResult``) accumulate beside them and
+:meth:`ContinuousBatcher.stats_snapshot` serialises everything. The
+scheduler's decision points — ``admit_wave`` > ``prefill_wave``,
+``dispatch_segment``, ``harvest``, ``reconstruct``, drain/fault
+instants — run under ``obs.tracing.span`` (Chrome-trace events when a
+tracer is configured; a shared null context otherwise). Open-loop
+load rides in-band: ``Request.arrival_s`` delays admission to the
+request's arrival instant and the scheduler idles across arrival gaps
+(``obs/loadgen.py`` — the ROADMAP-3 Poisson load generator).
 """
 
 from __future__ import annotations
@@ -165,6 +178,8 @@ from distributed_compute_pytorch_tpu.core.mesh import (
 from distributed_compute_pytorch_tpu.infer import (
     _CACHE_SPEC, _POOL_SPEC, sample_rows)
 from distributed_compute_pytorch_tpu.kv_pool import BlockPool, RadixCache
+from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
+from distributed_compute_pytorch_tpu.obs.tracing import instant, span
 from distributed_compute_pytorch_tpu.serve_lifecycle import (
     CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
 from distributed_compute_pytorch_tpu.train.elastic import call_with_timeout
@@ -186,7 +201,16 @@ class Request:
     expires is finalised ``timeout`` with no device work; one
     in-flight is cut at the next segment boundary, returning the
     partial stream (so expiry can overshoot by up to one segment's
-    wall time). ``None`` = no deadline (the legacy contract)."""
+    wall time). ``None`` = no deadline (the legacy contract).
+
+    ``arrival_s`` is the request's OPEN-LOOP arrival offset (seconds
+    from the serve call's start): the scheduler will not admit the
+    request before that wall-clock instant, and idles to the next
+    arrival when the pool drains early — how ``obs.loadgen`` drives a
+    Poisson arrival process through the synchronous engine. 0
+    (default) is the legacy everything-arrives-at-submission shape.
+    ``deadline_s`` still counts from SUBMISSION, so an offered-load
+    deadline covers queue-wait too (the SLO a router cares about)."""
 
     tokens: list
     max_new: int
@@ -195,6 +219,7 @@ class Request:
     top_p: float | None = None
     seed: int | None = None
     deadline_s: float | None = None
+    arrival_s: float = 0.0
 
 
 @dataclass
@@ -271,6 +296,19 @@ class ContinuousBatcher:
         its worst-case table after LRU eviction — plus 4 rows' worth of
         cache headroom when ``prefix_cache`` is on). Rounded up to a
         batch-axes multiple under a mesh.
+      heartbeat_s: emit a telemetry heartbeat every this many seconds
+        of serving: ``on_heartbeat(stats_snapshot())`` runs in the
+        scheduler thread between device calls (``dcp-serve`` prints it
+        as one stderr JSON line). ``None`` = off.
+      on_heartbeat: the heartbeat callback. Exceptions are swallowed —
+        telemetry must never fail a request.
+
+    Telemetry (ISSUE 8): every batcher owns a private
+    ``obs.metrics.Registry`` (``self.obs``); ``stats``/``waste`` are
+    dict-compatible views over it, the SLO histograms (queue-wait,
+    TTFT, TPOT, e2e) live beside them, and :meth:`stats_snapshot`
+    serialises the lot. :meth:`profile_next` arms on-demand XLA
+    profiling of the next N dispatched segments.
     """
 
     def __init__(self, model, params, *, slots: int, t_max: int,
@@ -282,7 +320,9 @@ class ContinuousBatcher:
                  max_recoveries: int = 2,
                  kv_block_tokens: int | None = None,
                  prefix_cache: bool = False,
-                 pool_blocks: int | None = None):
+                 pool_blocks: int | None = None,
+                 heartbeat_s: float | None = None,
+                 on_heartbeat=None):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -301,9 +341,14 @@ class ContinuousBatcher:
         if kv_block_tokens is not None and kv_block_tokens < 1:
             raise ValueError(
                 f"kv_block_tokens must be >= 1, got {kv_block_tokens}")
+        if heartbeat_s is not None and heartbeat_s <= 0:
+            raise ValueError(f"heartbeat_s must be > 0, got {heartbeat_s}")
         self.max_pending = max_pending
         self.tick_timeout_s = tick_timeout_s
         self.max_recoveries = max_recoveries
+        self.heartbeat_s = heartbeat_s
+        self.on_heartbeat = on_heartbeat
+        self._profile_req: dict | None = None
         self._cancel_mu = threading.Lock()
         self._cancelled: set[int] = set()
         self.model = model
@@ -448,22 +493,30 @@ class ContinuousBatcher:
         self._copy_c = jax.jit(self._copy_impl, donate_argnums=(0,))
 
     def _zero_stats(self):
+        # a FRESH per-batcher registry each session: the stats/waste
+        # dicts below are live views over it (obs.metrics.MetricDict —
+        # plain-dict reads/JSON, every write mirrored to a gauge), and
+        # the SLO histograms accumulate beside them until the next
+        # reset(). Telemetry-disabled runs keep the views counting —
+        # they are functional scheduler state, not diagnostics.
+        self.obs = obs_metrics.Registry()
         # transport counters (module docstring; asserted by the CPU
         # bench smoke): fetches == segments, every fetch with live rows
         # behind it issued AFTER the next segment's dispatch
-        self.stats = {"segments": 0, "fetches": 0, "fetches_overlapped": 0,
-                      "prefill_calls": 0, "prefill_rows": 0,
-                      # fault-tolerance counters (serve_lifecycle /
-                      # DESIGN.md "Serving under failure")
-                      "faults": 0, "reconstructions": 0,
-                      "reconstruction_rows": 0, "recovery_s": 0.0,
-                      # prefix-cache counters: admissions that attached,
-                      # tokens attached instead of re-prefilled (the
-                      # compute the cache saved), copy-on-write block
-                      # copies, and the pool's peak allocated fraction
-                      "prefix_hits": 0, "cached_prefix_tokens": 0,
-                      "prefill_tokens_saved": 0, "cow_copies": 0,
-                      "block_pool_occupancy": 0.0}
+        self.stats = obs_metrics.MetricDict(self.obs, "serve.", {
+            "segments": 0, "fetches": 0, "fetches_overlapped": 0,
+            "prefill_calls": 0, "prefill_rows": 0,
+            # fault-tolerance counters (serve_lifecycle /
+            # DESIGN.md "Serving under failure")
+            "faults": 0, "reconstructions": 0,
+            "reconstruction_rows": 0, "recovery_s": 0.0,
+            # prefix-cache counters: admissions that attached,
+            # tokens attached instead of re-prefilled (the
+            # compute the cache saved), copy-on-write block
+            # copies, and the pool's peak allocated fraction
+            "prefix_hits": 0, "cached_prefix_tokens": 0,
+            "prefill_tokens_saved": 0, "cow_copies": 0,
+            "block_pool_occupancy": 0.0})
         self.last_slot_leaks = 0   # rows still owned at serve() exit
         self.last_block_leaks = 0  # pool refs unaccounted at serve() exit
                                    # (both must be 0 — asserted by tests
@@ -471,8 +524,44 @@ class ContinuousBatcher:
         # row-tick attribution for the bench's waste_breakdown: useful
         # tokens = planned_ticks - tail (tail = post-eos + budget
         # rounding); parked ticks split by whether work was waiting
-        self.waste = {"planned_ticks": 0, "parked_admission_lag": 0,
-                      "parked_drain": 0}
+        self.waste = obs_metrics.MetricDict(self.obs, "serve.waste.", {
+            "planned_ticks": 0, "parked_admission_lag": 0,
+            "parked_drain": 0})
+        # per-request SLO distributions (serve_lifecycle.RequestResult
+        # field docs define the measurement points); seconds, log
+        # buckets 1 µs .. 10 ks
+        self._slo = {name: self.obs.histogram(f"serve.slo.{name}")
+                     for name in ("queue_wait_s", "ttft_s", "tpot_s",
+                                  "e2e_s")}
+
+    def stats_snapshot(self) -> dict:
+        """One JSON-serialisable view of everything the batcher
+        measures: the legacy ``stats``/``waste`` counters (the dicts
+        and the snapshot can never disagree — same registry), the SLO
+        histogram digests (count/mean/min/max/p50/p90/p95/p99), tick
+        totals and the leak counters. This is the record ``dcp-serve``
+        heartbeats, ``--metrics_jsonl`` appends, and ``bench.py``
+        embeds in every serve-stage ``extra`` block."""
+        return {
+            "stats": dict(self.stats),
+            "waste": dict(self.waste),
+            "slo": {name: h.summary() for name, h in self._slo.items()},
+            "ticks": self.ticks,
+            "slot_leaks": self.last_slot_leaks,
+            "block_leaks": self.last_block_leaks,
+        }
+
+    def profile_next(self, segments: int, profile_dir: str) -> None:
+        """Arm ON-DEMAND XLA profiling: the next ``segments``
+        dispatched decode segments run under ``jax.profiler`` traces
+        written to ``profile_dir`` (``dcp-serve --profile_segments``,
+        triggered by SIGUSR1 mid-run). The stop blocks on the last
+        profiled segment's tokens so the device work is actually in
+        the trace; one bounded sync, only when armed."""
+        if segments < 1:
+            raise ValueError(f"segments must be >= 1, got {segments}")
+        self._profile_req = {"remaining": int(segments),
+                             "dir": profile_dir, "active": False}
 
     def _mesh_ctx(self):
         return (use_mesh(self._mesh) if self._mesh is not None
@@ -743,6 +832,8 @@ class ContinuousBatcher:
                         f"[0, {vocab})")
         if r.deadline_s is not None and r.deadline_s <= 0:
             return f"deadline_s must be > 0, got {r.deadline_s}"
+        if getattr(r, "arrival_s", 0.0) < 0:
+            return f"arrival_s must be >= 0, got {r.arrival_s}"
         return None
 
     def _validate(self, requests):
@@ -850,16 +941,37 @@ class ContinuousBatcher:
         ticks_charged = [0] * n
         recs = [0] * n
         cached_prefix = [0] * n
+        # SLO timestamps (serve_lifecycle.RequestResult field docs):
+        # arrival (open-loop offset; t0 for the legacy shape), admission
+        # (its prefill wave's dispatch) and the first harvested token
+        arrive_at = [t0 + getattr(requests[i], "arrival_s", 0.0)
+                     for i in range(n)]
+        admit_at: list[float | None] = [None] * n
+        first_tok_at: list[float | None] = [None] * n
 
         def fin(i, status, tokens, error=None):
             if results[i] is not None:
                 return                      # first terminal event wins
+            now = time.monotonic()
+            latency = max(0.0, now - arrive_at[i])
+            qw = (admit_at[i] - arrive_at[i]
+                  if admit_at[i] is not None else None)
+            ttft = (first_tok_at[i] - arrive_at[i]
+                    if first_tok_at[i] is not None else None)
+            tokens = list(tokens)
+            tpot = ((latency - ttft) / (len(tokens) - 1)
+                    if ttft is not None and len(tokens) > 1 else None)
+            if admit_at[i] is not None:
+                self._slo["e2e_s"].record(latency)
+            if tpot is not None:
+                self._slo["tpot_s"].record(tpot)
             results[i] = RequestResult(
-                status=status, tokens=list(tokens), error=error,
+                status=status, tokens=tokens, error=error,
                 ticks=ticks_charged[i],
-                latency_s=time.monotonic() - t0,
+                latency_s=latency,
                 recoveries=recs[i],
-                cached_prefix_tokens=cached_prefix[i])
+                cached_prefix_tokens=cached_prefix[i],
+                queue_wait_s=qw, ttft_s=ttft, tpot_s=tpot)
 
         # -- submission: validation failures are structured, not raised
         valid = []
@@ -913,6 +1025,9 @@ class ContinuousBatcher:
         admit_seq = [0]
         draining = {"on": False, "deadline": None}
         fault_state = {"recoveries": 0, "consecutive": 0}
+        hb = {"next": (t0 + self.heartbeat_s)
+              if (self.heartbeat_s is not None
+                  and self.on_heartbeat is not None) else None}
 
         def free_row(b):
             """Release row ``b``'s pool references and park its table at
@@ -931,9 +1046,16 @@ class ContinuousBatcher:
             deadline. Pure host bookkeeping — no device work, so the
             checks cost nothing on the hot path."""
             now = time.monotonic()
+            if hb["next"] is not None and now >= hb["next"]:
+                hb["next"] = now + self.heartbeat_s
+                try:
+                    self.on_heartbeat(self.stats_snapshot())
+                except Exception:   # noqa: BLE001 — telemetry must
+                    pass            # never fail a request
             if (drain is not None and getattr(drain, "preempted", False)
                     and not draining["on"]):
                 draining["on"] = True
+                instant("drain_start", queued=len(queue))
                 if drain_deadline_s is not None:
                     draining["deadline"] = now + drain_deadline_s
                 for i in list(queue):
@@ -975,13 +1097,18 @@ class ContinuousBatcher:
             take: list[int] = []
             if draining["on"]:
                 return take                 # drain: admission stopped
+            now = time.monotonic()
             if self.admit_policy == "fifo":
-                while queue and len(take) < k_free:
+                # an unarrived head BLOCKS the wave: open-loop arrivals
+                # keep the same no-leapfrog fairness as submissions
+                while (queue and len(take) < k_free
+                       and arrive_at[queue[0]] <= now):
                     take.append(queue.pop(0))
             else:
                 i = 0
                 while i < len(queue) and len(take) < k_free:
-                    if self._fits(requests[queue[i]]):
+                    if (self._fits(requests[queue[i]])
+                            and arrive_at[queue[i]] <= now):
                         take.append(queue.pop(i))
                     else:
                         i += 1
@@ -997,48 +1124,56 @@ class ContinuousBatcher:
             take = pick_admissions(len(free))
             if not take:
                 return
-            rows = free[:len(take)]
-            entries, cow_all = [], []
-            for b, ri in zip(rows, take):
-                req = requests[ri]
-                self._temp[b] = req.temperature
-                self._topk[b] = req.top_k or 0
-                self._topp[b] = req.top_p if req.top_p is not None else 2.0
-                self._seed[b] = np.uint32(
-                    req.seed if req.seed is not None else ri)
-                slot = table[b]
-                slot.req_index = ri
-                slot.out = []
-                slot.remaining = req.max_new
-                slot.admit_seq = admit_seq[0]
-                admit_seq[0] += 1
-                m, cow = self._assign_blocks(b, slot, list(req.tokens),
-                                             req.max_new)
-                cow_all.extend(cow)
-                cached_prefix[ri] = m
-                if m:
-                    self.stats["prefix_hits"] += 1
-                self.stats["cached_prefix_tokens"] += m
-                self.stats["prefill_tokens_saved"] += m
-                entries.append((b, list(req.tokens), m))
-            self.stats["cow_copies"] += len(cow_all)
-            if cow_all:
-                self._copy_blocks(cow_all)
-            self._prefill_wave(entries)
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_rows"] += len(take)
-            if self._radix is not None:
-                # the wave's freshly-prefilled heads enter the cache so
-                # later arrivals can attach to them (insert AFTER the
-                # prefill dispatch: device order makes the blocks valid
-                # before any attacher's wave can read them)
-                for b, known, m in entries:
-                    head = known[:-1]
-                    if head:
-                        nb_head = -(-len(head) // self.bt)
-                        self._radix.insert(
-                            head, [int(x) for x in
-                                   self._tables[b, :nb_head]])
+            with span("admit_wave", rows=len(take)):
+                now = time.monotonic()
+                rows = free[:len(take)]
+                entries, cow_all = [], []
+                for b, ri in zip(rows, take):
+                    req = requests[ri]
+                    admit_at[ri] = now
+                    self._slo["queue_wait_s"].record(
+                        max(0.0, now - arrive_at[ri]))
+                    self._temp[b] = req.temperature
+                    self._topk[b] = req.top_k or 0
+                    self._topp[b] = (req.top_p if req.top_p is not None
+                                     else 2.0)
+                    self._seed[b] = np.uint32(
+                        req.seed if req.seed is not None else ri)
+                    slot = table[b]
+                    slot.req_index = ri
+                    slot.out = []
+                    slot.remaining = req.max_new
+                    slot.admit_seq = admit_seq[0]
+                    admit_seq[0] += 1
+                    m, cow = self._assign_blocks(b, slot,
+                                                 list(req.tokens),
+                                                 req.max_new)
+                    cow_all.extend(cow)
+                    cached_prefix[ri] = m
+                    if m:
+                        self.stats["prefix_hits"] += 1
+                    self.stats["cached_prefix_tokens"] += m
+                    self.stats["prefill_tokens_saved"] += m
+                    entries.append((b, list(req.tokens), m))
+                self.stats["cow_copies"] += len(cow_all)
+                if cow_all:
+                    self._copy_blocks(cow_all)
+                self._prefill_wave(entries)
+                self.stats["prefill_calls"] += 1
+                self.stats["prefill_rows"] += len(take)
+                if self._radix is not None:
+                    # the wave's freshly-prefilled heads enter the cache
+                    # so later arrivals can attach to them (insert AFTER
+                    # the prefill dispatch: device order makes the
+                    # blocks valid before any attacher's wave can read
+                    # them)
+                    for b, known, m in entries:
+                        head = known[:-1]
+                        if head:
+                            nb_head = -(-len(head) // self.bt)
+                            self._radix.insert(
+                                head, [int(x) for x in
+                                       self._tables[b, :nb_head]])
 
         def dispatch_segment():
             """Dispatch ONE compiled segment (no fetch). Returns the
@@ -1070,15 +1205,30 @@ class ContinuousBatcher:
                     key = ("parked_admission_lag" if pending
                            else "parked_drain")
                     self.waste[key] += self.S
-            with self._mesh_ctx():
-                (self._caches, self._cur_tok, self._n_logical, toks
-                 ) = self._segment_c(
-                    self.params, self._caches, jnp.asarray(tables_now),
-                    self._cur_tok, self._n_logical,
-                    jnp.asarray(self._row_pos, jnp.int32),
-                    jnp.asarray(self._temp), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._seed),
-                    sampling=sampling)
+            prof = self._profile_req
+            if prof is not None and not prof["active"]:
+                # profile_next() armed mid-run: open the XLA trace just
+                # before this segment's dispatch
+                jax.profiler.start_trace(prof["dir"])
+                prof["active"] = True
+            with span("dispatch_segment", rows=len(plan)):
+                with self._mesh_ctx():
+                    (self._caches, self._cur_tok, self._n_logical, toks
+                     ) = self._segment_c(
+                        self.params, self._caches, jnp.asarray(tables_now),
+                        self._cur_tok, self._n_logical,
+                        jnp.asarray(self._row_pos, jnp.int32),
+                        jnp.asarray(self._temp), jnp.asarray(self._topk),
+                        jnp.asarray(self._topp), jnp.asarray(self._seed),
+                        sampling=sampling)
+            if prof is not None and prof["active"]:
+                prof["remaining"] -= 1
+                if prof["remaining"] <= 0:
+                    # one bounded sync so the profiled segments' device
+                    # work is actually inside the trace window
+                    jax.block_until_ready(toks)
+                    jax.profiler.stop_trace()
+                    self._profile_req = None
             for b in range(self.B):
                 self._row_pos[b] += self.S
             self.ticks += self.S
@@ -1099,41 +1249,52 @@ class ContinuousBatcher:
             the next segment was already dispatched (the counter the
             bench smoke asserts)."""
             toks, plan = seg
-            self.stats["fetches"] += 1
-            if overlapped:
-                self.stats["fetches_overlapped"] += 1
-            if chaos is not None:
-                chaos.pre_fetch(self.stats["segments"],
-                                [ri for _, ri, _, _ in plan])
-
-            def fetch():
+            with span("harvest", overlapped=overlapped):
+                self.stats["fetches"] += 1
+                if overlapped:
+                    self.stats["fetches_overlapped"] += 1
                 if chaos is not None:
-                    chaos.in_fetch(self.stats["segments"])
-                return np.asarray(toks)
+                    chaos.pre_fetch(self.stats["segments"],
+                                    [ri for _, ri, _, _ in plan])
 
-            if self.tick_timeout_s is not None:
-                toks_h = call_with_timeout(fetch, self.tick_timeout_s,
-                                           "serve tick harvest")
-            else:
-                toks_h = fetch()
-            for b, ri, take, done_after in plan:
-                if results[ri] is not None:
-                    # the request finished (eos) — or was cancelled /
-                    # timed out — in an earlier segment while this one
-                    # was already in flight: its ticks are overlap tail
-                    # waste, never tokens
-                    continue
-                slot = table[b]
-                if slot.req_index != ri:
-                    continue   # row re-admitted after an early free
-                slot.out.extend(int(t) for t in toks_h[b, :take])
-                done = done_after
-                if self.eos_id is not None and self.eos_id in slot.out:
-                    slot.out = slot.out[:slot.out.index(self.eos_id) + 1]
-                    done = True
-                if done:
-                    fin(ri, OK, slot.out)
-                    free_row(b)
+                def fetch():
+                    if chaos is not None:
+                        chaos.in_fetch(self.stats["segments"])
+                    return np.asarray(toks)
+
+                if self.tick_timeout_s is not None:
+                    toks_h = call_with_timeout(fetch, self.tick_timeout_s,
+                                               "serve tick harvest")
+                else:
+                    toks_h = fetch()
+                now = time.monotonic()
+                for b, ri, take, done_after in plan:
+                    if results[ri] is not None:
+                        # the request finished (eos) — or was cancelled
+                        # / timed out — in an earlier segment while this
+                        # one was already in flight: its ticks are
+                        # overlap tail waste, never tokens
+                        continue
+                    slot = table[b]
+                    if slot.req_index != ri:
+                        continue   # row re-admitted after an early free
+                    was_empty = not slot.out
+                    slot.out.extend(int(t) for t in toks_h[b, :take])
+                    if (was_empty and slot.out
+                            and first_tok_at[ri] is None):
+                        # first generated token reached the host: TTFT
+                        first_tok_at[ri] = now
+                        self._slo["ttft_s"].record(
+                            max(0.0, now - arrive_at[ri]))
+                    done = done_after
+                    if (self.eos_id is not None
+                            and self.eos_id in slot.out):
+                        slot.out = slot.out[
+                            :slot.out.index(self.eos_id) + 1]
+                        done = True
+                    if done:
+                        fin(ri, OK, slot.out)
+                        free_row(b)
 
         def handle_fault(e: BaseException) -> bool:
             """A device interaction failed (raised or hung). Recover by
@@ -1148,6 +1309,7 @@ class ContinuousBatcher:
             fault_state["consecutive"] += 1
             t_fault = time.monotonic()
             err = f"{type(e).__name__}: {e}"
+            instant("fault", error=err)
             if fault_state["recoveries"] >= self.max_recoveries:
                 msg = (f"device lost after {fault_state['recoveries']} "
                        f"recovery attempt(s) ({err})")
@@ -1172,16 +1334,42 @@ class ContinuousBatcher:
             for slot in table:
                 if slot.req_index >= 0:
                     recs[slot.req_index] += 1
-            self._reconstruct(table, requests, fin, free_row)
+            with span("reconstruct"):
+                self._reconstruct(table, requests, fin, free_row)
             self.stats["reconstructions"] += 1
             self.stats["recovery_s"] += time.monotonic() - t_fault
             return True
+
+        def dispatch_or_wait():
+            """``dispatch_segment`` across open-loop arrival gaps: when
+            nothing is live but the queue holds FUTURE arrivals
+            (``Request.arrival_s``), idle to the earliest one in
+            bounded naps (cancel/deadline/drain stay responsive via
+            ``police``) and admit. The legacy all-at-submission shape
+            never waits — every queued request has already arrived —
+            and the overlap dispatch never calls this (it must not
+            block with a harvest pending)."""
+            while True:
+                seg = dispatch_segment()
+                if seg is not None or draining["on"]:
+                    return seg
+                now = time.monotonic()
+                future = [arrive_at[i] for i in queue
+                          if arrive_at[i] > now]
+                if not future:
+                    # nothing live, nothing still to arrive: the queue
+                    # is empty or holds only never-admissible requests
+                    # (skip_fit horizon rejects, reported at exit)
+                    return None
+                time.sleep(min(min(future) - now, 0.02))
+                police()
+                admit_wave()
 
         # ---- the overlapped loop: dispatch N+1 BEFORE fetching N,
         # every device interaction under the fault/recovery wrap ----
         police()
         admit_wave()
-        seg = dispatch_segment()
+        seg = dispatch_or_wait()
         while seg is not None:
             nxt = None
             try:
@@ -1198,8 +1386,9 @@ class ContinuousBatcher:
             police()
             admit_wave()                   # freed rows -> next wave
             if nxt is None:
-                nxt = dispatch_segment()   # revived by fresh admissions
-                                           # (or post-reconstruction)
+                nxt = dispatch_or_wait()   # revived by fresh admissions,
+                                           # post-reconstruction, or the
+                                           # next open-loop arrival
             seg = nxt
 
         # whatever is still queued can never be admitted: skip_fit's
@@ -1303,7 +1492,8 @@ class ContinuousBatcher:
                 if self._block_takes_moe_capacity_rows:
                     kw["moe_capacity_rows"] = jnp.asarray(
                         caps + [1] * (Kp - K), jnp.int32)
-            with self._mesh_ctx():
+            with span("prefill_wave", rows=len(entries)), \
+                    self._mesh_ctx():
                 self._caches = self._admit_c(
                     self.params, self._caches, jnp.asarray(tables_wave),
                     jnp.asarray(prompt), jnp.asarray(pmask),

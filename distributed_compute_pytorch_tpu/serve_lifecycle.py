@@ -86,7 +86,20 @@ class RequestResult:
     to the radix prefix cache instead of re-running prefill (0 with
     the cache off — the paged KV pool's per-request observability,
     surfaced as ``"cached_prefix"`` on every ``dcp-serve`` output
-    line)."""
+    line).
+
+    SLO timing (ISSUE 8 / the ROADMAP-3 router's dispatch signals; all
+    wall-clock seconds, measured from the request's ARRIVAL — its
+    ``arrival_s`` offset into the serve call, 0 for the legacy
+    everything-at-submission shape, so ``latency_s`` is unchanged for
+    existing callers): ``queue_wait_s`` is arrival -> admission (its
+    prefill wave's dispatch); ``ttft_s`` is arrival -> the first
+    harvested token reaching the host (``None`` when no token was ever
+    produced); ``tpot_s`` is the mean per-token interval AFTER the
+    first token, ``(latency_s - ttft_s) / (len(tokens) - 1)``
+    (``None`` below 2 tokens). Every admitted request's values also
+    land in the batcher's SLO histograms
+    (``ContinuousBatcher.stats_snapshot()["slo"]``)."""
 
     status: str = OK
     tokens: list = field(default_factory=list)
@@ -95,6 +108,9 @@ class RequestResult:
     latency_s: float = 0.0
     recoveries: int = 0
     cached_prefix_tokens: int = 0
+    queue_wait_s: float | None = None
+    ttft_s: float | None = None
+    tpot_s: float | None = None
 
     @property
     def ok(self) -> bool:

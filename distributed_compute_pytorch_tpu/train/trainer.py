@@ -30,6 +30,9 @@ from distributed_compute_pytorch_tpu.data.loader import (
     DeviceFeeder, StreamingDeviceFeeder)
 from distributed_compute_pytorch_tpu.data.shards import ShardedFileDataset
 from distributed_compute_pytorch_tpu.models.registry import build_model
+from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
+from distributed_compute_pytorch_tpu.obs.tracing import (
+    Tracer, configure_tracer, span)
 from distributed_compute_pytorch_tpu.train import checkpoint
 from distributed_compute_pytorch_tpu.train.elastic import (
     ClusterPreemption, Heartbeat, Preempted, PreemptionGuard, restart_count)
@@ -255,7 +258,17 @@ class Trainer:
             sharded=config.ckpt_sharded, keep_last=config.keep_last)
             if config.async_checkpoint else None)
 
-        self.logger = MetricLogger()
+        # telemetry (ISSUE 8, obs/): JSONL metric sink + host span tracer.
+        # The logger closes on EVERY fit() exit path (its try/finally) and
+        # the tracer dumps a Perfetto-loadable Chrome trace there too.
+        self.logger = MetricLogger(config.metrics_jsonl)
+        self._tracer = (Tracer() if (config.trace_path
+                                     and is_coordinator()) else None)
+        if self._tracer is not None:
+            configure_tracer(self._tracer)
+        # --collective_stats: census the step's gradient collectives ONCE,
+        # at the first batch (needs concrete args to trace against)
+        self._collective_stats_done = not config.collective_stats
         log0(f"mesh: {dict(self.mesh.shape)} | dp world size: "
              f"{dp_world_size(self.mesh)} | devices: {len(self.mesh.devices.flat)}"
              f" | model: {config.model} | dataset: {self.train_data.name}")
@@ -377,20 +390,31 @@ class Trainer:
         # permuted copy — safe to hand to the async writer)
         state = (self.state if self._layout is None
                  else self._layout[0](self.state))
-        if self.checkpointer is not None:
-            self.checkpointer.save(cfg.ckpt_path, state, epoch=epoch,
-                                   extra=extra)
-        elif cfg.ckpt_sharded:
-            checkpoint.save_sharded(cfg.ckpt_path, state, epoch=epoch,
-                                    extra=extra, keep_last=cfg.keep_last)
-        else:
-            checkpoint.save(cfg.ckpt_path, state, epoch=epoch,
-                            extra=extra, keep_last=cfg.keep_last)
+        with span("checkpoint", epoch=epoch):
+            if self.checkpointer is not None:
+                self.checkpointer.save(cfg.ckpt_path, state, epoch=epoch,
+                                       extra=extra)
+            elif cfg.ckpt_sharded:
+                checkpoint.save_sharded(cfg.ckpt_path, state, epoch=epoch,
+                                        extra=extra, keep_last=cfg.keep_last)
+            else:
+                checkpoint.save(cfg.ckpt_path, state, epoch=epoch,
+                                extra=extra, keep_last=cfg.keep_last)
 
     def _finish(self) -> None:
-        """Flush any in-flight async checkpoint write, then the logger."""
+        """Flush any in-flight async checkpoint write, dump the span
+        trace, then close the logger. Runs on EVERY ``fit`` exit path
+        (its try/finally), including preemption, and is idempotent."""
         if self.checkpointer is not None:
             self.checkpointer.close()
+        if self._tracer is not None:
+            try:
+                self._tracer.dump(self.config.trace_path)
+                log0(f"span trace written to {self.config.trace_path}")
+            finally:
+                configure_tracer(None)
+                self._tracer.close()
+                self._tracer = None
         self.logger.close()
 
     def train_epoch(self, epoch: int, skip: int = 0,
@@ -405,10 +429,20 @@ class Trainer:
         timer = Timer()
         steps = self.train_feed.steps_per_epoch
         metrics = None
-        for b, (x, y) in enumerate(self.train_feed.epoch(epoch, skip=skip),
-                                   start=skip):
+        # explicit iterator so the input-pipeline stall (host batch prep +
+        # transfer) is its own span, distinct from train_step dispatch —
+        # the first question a slow run asks is data-bound vs compute-bound
+        it = enumerate(self.train_feed.epoch(epoch, skip=skip), start=skip)
+        while True:
+            with span("data_wait"):
+                nxt = next(it, None)
+            if nxt is None:
+                break
+            b, (x, y) = nxt
             self._maybe_inject_fault(epoch * steps + b)
-            self.state, metrics = self.train_step(self.state, x, y)
+            self._maybe_collective_stats(x, y)
+            with span("train_step"):
+                self.state, metrics = self.train_step(self.state, x, y)
             if "skipped" in metrics:
                 # device scalar, queued unread: fetched at log cadence
                 self._skip_hist.append(metrics["skipped"])
@@ -418,6 +452,9 @@ class Trainer:
                 loss = float(metrics["loss"])
                 self._poll_nonfinite(loss, epoch, b)
                 self.logger.train_line(epoch, b, steps, loss)
+                mem = obs_metrics.device_memory_gauges(obs_metrics.REGISTRY)
+                if mem:
+                    self.logger.telemetry("memory", mem)
                 if self.heartbeat is not None:
                     self.heartbeat.beat(epoch, epoch * steps + b)
             if self._should_preempt(guard, epoch * steps + b):
@@ -508,6 +545,29 @@ class Trainer:
             raise RuntimeError(
                 f"injected fault at step {global_step} (--fault_at_step)")
 
+    def _maybe_collective_stats(self, x, y) -> None:
+        """One-time gradient-collective census (``--collective_stats``):
+        trace the compiled step against the first real batch and record
+        the boundary/in-loop reduction counts and wire bytes per chip
+        (``parallel.collectives.grad_collective_stats``) to the registry
+        and the metrics JSONL. Tracing only — no device work, and the
+        donated buffers are untouched."""
+        if self._collective_stats_done:
+            return
+        self._collective_stats_done = True
+        from distributed_compute_pytorch_tpu.parallel.collectives import (
+            grad_collective_stats)
+        try:
+            stats = grad_collective_stats(self.train_step, self.state, x, y)
+        except Exception as e:   # noqa: BLE001 — diagnostics must not kill a run
+            log0(f"WARNING: --collective_stats trace failed: {e}")
+            return
+        for k, v in stats.items():
+            obs_metrics.REGISTRY.gauge(f"collectives.grad.{k}").set(v)
+        self.logger.telemetry("collectives", {"grad": stats})
+        log0(f"grad collectives per update: {stats['boundary']} boundary, "
+             f"{stats['in_loop']} in-loop, {stats['bytes']} bytes/chip")
+
     def evaluate(self, epoch: int,
                  guard: PreemptionGuard | None = None) -> dict:
         """Full eval pass == reference ``test`` (``main.py:70-95``), with the
@@ -581,49 +641,54 @@ class Trainer:
         last_eval = {}
         # NOTE: no heartbeat before the first step — a pre-compile beat
         # would arm the supervisor's staleness timer and a long XLA compile
-        # would then read as a hang
-        with maybe_profile(cfg.profile_dir), PreemptionGuard() as guard:
-            if self._pending_eval_epoch is not None:
-                # previous incarnation was preempted during this epoch's
-                # eval (manifest eval_done=False): report its metrics now,
-                # then mark the checkpoint evaluated so another bounce
-                # doesn't repeat the pass
-                pending = self._pending_eval_epoch
-                try:
-                    last_eval = self.evaluate(pending, guard=guard)
-                except Preempted:
-                    self._finish()
-                    return {"preempted": True, "epoch": pending}
-                self._save_ckpt(pending, extra={"eval_done": True})
-                self._pending_eval_epoch = None
-            for epoch in range(self.start_epoch, cfg.epochs):
-                skip = self.start_step if epoch == self.start_epoch else 0
-                timer = Timer()
-                try:
-                    throughput = self.train_epoch(epoch, skip=skip,
-                                                  guard=guard)
-                    last_eval = self.evaluate(epoch, guard=guard)
-                except Preempted:
-                    self._finish()
-                    return {"preempted": True, "epoch": epoch}
-                self.logger.epoch_time(epoch, timer.elapsed(), throughput)
-                self._save_ckpt(epoch, extra={"eval_done": True})
-                if guard.preempted and self.cluster is not None:
-                    # multi-host: record the request and keep going — the
-                    # NEXT epoch's first train steps coordinate the stop
-                    # (a unilateral exit here would leave the other hosts
-                    # hanging in their next collective). A last-epoch
-                    # signal simply lets the run complete.
-                    self.cluster.request()
-                elif guard.preempted:
-                    # signal arrived after eval (eval-time signals raise
-                    # Preempted inside evaluate()): during the epoch-time
-                    # print or the epoch-end save. The checkpoint just
-                    # written is the resume point — exit now rather than
-                    # starting another epoch.
-                    log0(f"preempted during epoch {epoch} epoch-end save; "
-                         f"checkpoint written to {cfg.ckpt_path}")
-                    self._finish()
-                    return {"preempted": True, "epoch": epoch}
-        self._finish()
-        return last_eval
+        # would then read as a hang.
+        # The try/finally is the MetricLogger-lifecycle fix (ISSUE 8):
+        # _finish (async-ckpt flush, trace dump, JSONL close) runs on
+        # every exit path — normal completion, preemption, AND errors —
+        # instead of being repeated at each return site.
+        try:
+            with maybe_profile(cfg.profile_dir), PreemptionGuard() as guard:
+                if self._pending_eval_epoch is not None:
+                    # previous incarnation was preempted during this epoch's
+                    # eval (manifest eval_done=False): report its metrics now,
+                    # then mark the checkpoint evaluated so another bounce
+                    # doesn't repeat the pass
+                    pending = self._pending_eval_epoch
+                    try:
+                        with span("eval", epoch=pending):
+                            last_eval = self.evaluate(pending, guard=guard)
+                    except Preempted:
+                        return {"preempted": True, "epoch": pending}
+                    self._save_ckpt(pending, extra={"eval_done": True})
+                    self._pending_eval_epoch = None
+                for epoch in range(self.start_epoch, cfg.epochs):
+                    skip = self.start_step if epoch == self.start_epoch else 0
+                    timer = Timer()
+                    try:
+                        throughput = self.train_epoch(epoch, skip=skip,
+                                                      guard=guard)
+                        with span("eval", epoch=epoch):
+                            last_eval = self.evaluate(epoch, guard=guard)
+                    except Preempted:
+                        return {"preempted": True, "epoch": epoch}
+                    self.logger.epoch_time(epoch, timer.elapsed(), throughput)
+                    self._save_ckpt(epoch, extra={"eval_done": True})
+                    if guard.preempted and self.cluster is not None:
+                        # multi-host: record the request and keep going — the
+                        # NEXT epoch's first train steps coordinate the stop
+                        # (a unilateral exit here would leave the other hosts
+                        # hanging in their next collective). A last-epoch
+                        # signal simply lets the run complete.
+                        self.cluster.request()
+                    elif guard.preempted:
+                        # signal arrived after eval (eval-time signals raise
+                        # Preempted inside evaluate()): during the epoch-time
+                        # print or the epoch-end save. The checkpoint just
+                        # written is the resume point — exit now rather than
+                        # starting another epoch.
+                        log0(f"preempted during epoch {epoch} epoch-end "
+                             f"save; checkpoint written to {cfg.ckpt_path}")
+                        return {"preempted": True, "epoch": epoch}
+            return last_eval
+        finally:
+            self._finish()
