@@ -25,7 +25,7 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
-	serve-load-smoke
+	serve-load-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -65,6 +65,9 @@ bench:
 #   tokens are identical to the unloaded path, no slot/block leaks,
 #   the span trace validates as Chrome-trace JSON, and the disabled-
 #   telemetry record path costs < 1% of a segment wall
+# - bench-diff (last): the regression gate's self-test — one smoke's
+#   record diffed against itself through obs/regress.py must pass
+#   (a gate that flags identical runs is broken)
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --zero1-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-smoke
@@ -72,6 +75,20 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
+	$(MAKE) bench-diff
+
+# the bench-regression gate (obs/regress.py): BASE/NEW default to a
+# fresh smoke record diffed against itself (the self-consistency check
+# bench-smoke runs); point them at two bench records / BENCH_r*.json
+# files to gate a real trajectory step, e.g.
+#   make bench-diff BASE=BENCH_r04.json NEW=BENCH_r05.json
+BASE ?= /tmp/_bench_diff_self.json
+NEW ?= /tmp/_bench_diff_self.json
+bench-diff:
+	@if [ "$(BASE)" = "/tmp/_bench_diff_self.json" ]; then \
+		JAX_PLATFORMS=cpu python bench.py --zero1-smoke > /tmp/_bench_diff_self.json; \
+	fi
+	JAX_PLATFORMS=cpu python bench.py --diff $(BASE) $(NEW)
 
 serve-chaos-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke

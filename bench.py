@@ -39,6 +39,21 @@ import os
 import sys
 import time
 
+# every printed bench record (headline and smokes) carries this stamp
+# and a stable stage-key layout, so obs/regress.py's bench-diff can
+# compare any two records — including historical BENCH_r*.json files —
+# without per-era heuristics. Bump only on layout-breaking changes;
+# key ADDITIONS are compatible (the diff reports them as only_new).
+SCHEMA_VERSION = 1
+
+
+def _print_record(rec: dict) -> None:
+    """The one output contract: stamp and print a bench record as a
+    single JSON line (what the driver captures and bench-diff loads)."""
+    rec.setdefault("schema_version", SCHEMA_VERSION)
+    print(json.dumps(rec))
+
+
 def _two_length_dt(time_n, iters, repeats=3):
     """Per-iteration time from a two-length difference, with a recorded
     spread (the variance discipline: every headline number is
@@ -1389,8 +1404,8 @@ def zero1_smoke():
     n_chips = len(jax.devices())
     mesh = make_mesh("data=-1")
     rec = _bench_zero1(jax, jnp, np, mesh, n_chips, None, tiny=True)
-    print(json.dumps({"metric": "zero1_update_sharding_smoke",
-                      "n_chips": n_chips, **rec}))
+    _print_record({"metric": "zero1_update_sharding_smoke",
+                   "n_chips": n_chips, **rec})
     ratio = rec["opt_bytes_ratio"]
     if n_chips > 1 and not ratio > 1.5:
         raise SystemExit(f"opt_bytes_ratio {ratio} — update sharding did "
@@ -1418,8 +1433,8 @@ def grad_accum_smoke():
     n_chips = len(jax.devices())
     mesh = make_mesh("data=-1")
     rec = _bench_grad_accum(jax, jnp, np, mesh, n_chips, None, tiny=True)
-    print(json.dumps({"metric": "grad_accum_boundary_smoke",
-                      "n_chips": n_chips, **rec}))
+    _print_record({"metric": "grad_accum_boundary_smoke",
+                   "n_chips": n_chips, **rec})
     checks = {
         "no_collectives_in_scan":
             rec["boundary"]["grad_collectives_in_scan"] == 0,
@@ -1504,11 +1519,11 @@ def serve_smoke():
             + w["parked_drain"] == cb.ticks * cb.B
             and w["planned_ticks"] >= useful),
     }
-    print(json.dumps({"metric": "serve_overlap_smoke",
-                      "snapshot": cb.stats_snapshot(),
-                      "stats": s, "waste": w, "useful_tokens": useful,
-                      "cache_spec": str(cb._caches[0]["kv"].sharding.spec),
-                      "checks": checks}))
+    _print_record({"metric": "serve_overlap_smoke",
+                   "snapshot": cb.stats_snapshot(),
+                   "stats": s, "waste": w, "useful_tokens": useful,
+                   "cache_spec": str(cb._caches[0]["kv"].sharding.spec),
+                   "checks": checks})
     bad = [k for k, ok in checks.items() if not ok]
     if bad:
         raise SystemExit(f"serve smoke failed: {bad}")
@@ -1584,7 +1599,7 @@ def serve_chaos_smoke():
         "zero_slot_leaks": cb.last_slot_leaks == 0,
         "recovery_time_recorded": cb.stats["recovery_s"] > 0,
     }
-    print(json.dumps({
+    _print_record({
         "metric": "serve_chaos_smoke",
         "useful_tokens": useful,
         "goodput_tok_s": round(goodput, 2),
@@ -1594,7 +1609,7 @@ def serve_chaos_smoke():
         "recovery_s": round(cb.stats["recovery_s"], 4),
         "reconstruction_rows": cb.stats["reconstruction_rows"],
         "stats": cb.stats, "snapshot": cb.stats_snapshot(),
-        "checks": checks}))
+        "checks": checks})
     bad = [k for k, ok in checks.items() if not ok]
     if bad:
         raise SystemExit(f"serve chaos smoke failed: {bad}")
@@ -1702,7 +1717,7 @@ def serve_prefix_smoke():
         # against gross regression
         "ttft_not_degraded": ttft_on <= ttft_off * 2.0,
     }
-    print(json.dumps({
+    _print_record({
         "metric": "serve_prefix_smoke",
         "requests": len(reqs),
         "prefix_hits": s["prefix_hits"],
@@ -1716,7 +1731,7 @@ def serve_prefix_smoke():
         "ttft_proxy_s": {"cache_off": round(ttft_off, 4),
                          "cache_on": round(ttft_on, 4)},
         "snapshot": on.stats_snapshot(),
-        "checks": checks}))
+        "checks": checks})
     bad = [k for k, ok in checks.items() if not ok]
     if bad:
         raise SystemExit(f"serve prefix smoke failed: {bad}")
@@ -1825,7 +1840,7 @@ def serve_load_smoke():
     pct = {name: {k: slo.get(name, {}).get(k) for k in
                   ("count", "p50", "p95", "p99")}
            for name in ("queue_wait_s", "ttft_s", "tpot_s", "e2e_s")}
-    print(json.dumps({
+    _print_record({
         "metric": "serve_load_smoke",
         "offered_rate_rps": spec.rate_rps, "requests": len(load),
         "wall_s": round(report["wall_s"], 3),
@@ -1835,7 +1850,7 @@ def serve_load_smoke():
         "trace_events": len(events),
         "trace_errors": trace_errors[:4],
         "disabled_overhead_frac": round(overhead_frac, 6),
-        "checks": checks}))
+        "checks": checks})
     bad = [k for k, ok in checks.items() if not ok]
     if bad:
         raise SystemExit(f"serve load smoke failed: {bad}")
@@ -1856,6 +1871,13 @@ def _max_spread(rec):
 
 
 def main():
+    if "--diff" in sys.argv:
+        # bench-diff: compare two bench records stage-by-stage using
+        # each stage's recorded spread as the noise floor; exit 1 on
+        # regression (obs/regress.py; `make bench-diff`)
+        from distributed_compute_pytorch_tpu.obs.regress import (
+            main as diff_main)
+        return diff_main(sys.argv[sys.argv.index("--diff") + 1:])
     if "--zero1-smoke" in sys.argv:
         return zero1_smoke()
     if "--serve-smoke" in sys.argv:
@@ -1954,6 +1976,7 @@ def main():
         base = json.load(f)["mnist_convnet_train_samples_per_sec"]["value"]
 
     result = {
+        "schema_version": SCHEMA_VERSION,
         "metric": "mnist_convnet_train_samples_per_sec_per_chip",
         "value": round(sps_per_chip, 2),
         "unit": "samples/sec/chip",
@@ -2082,7 +2105,7 @@ def main():
             "details_file": "benchmarks/bench_details_latest.json",
         },
     }
-    print(json.dumps(compact))
+    _print_record(compact)
 
 
 if __name__ == "__main__":

@@ -232,6 +232,11 @@ def main(argv=None) -> int:
                    help="write a Chrome-trace JSON of host-side spans "
                         "(admit/dispatch/harvest/reconstruct) here at "
                         "exit; load in Perfetto")
+    p.add_argument("--flight_recorder", type=str, default=None,
+                   help="record scheduler events in a bounded ring and "
+                        "dump them as JSON to this path on any failure "
+                        "(watchdog timeout, reconstruction, poison "
+                        "eviction, SIGTERM drain, crash; obs/flight.py)")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="XLA profiler traces: alone, profiles the whole "
                         "serve run (utils.timing.maybe_profile); with "
@@ -316,6 +321,11 @@ def main(argv=None) -> int:
     tracer = Tracer() if args.trace_path else None
     if tracer is not None:
         configure_tracer(tracer)
+    from distributed_compute_pytorch_tpu.obs import flight
+    if args.flight_recorder:
+        flight.configure_flight(
+            flight.FlightRecorder(path=args.flight_recorder))
+        flight.install_crash_hook()
     metrics_f = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
 
     def on_heartbeat(snap):

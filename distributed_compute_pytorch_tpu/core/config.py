@@ -180,7 +180,15 @@ class Config:
                                       # registry + metrics_jsonl (reuses
                                       # parallel.collectives.
                                       # grad_collective_stats; costs one
-                                      # extra trace at startup)
+                                      # extra trace at startup), plus the
+                                      # post-compile HLO census (ISSUE 10)
+    # --- forensics (ISSUE 10, obs/flight.py + obs/sentinel.py)
+    flight_recorder: str | None = None  # dump path: ring-buffer of span/
+                                        # instant events written here on any
+                                        # failure (nonfinite raise, crash)
+    divergence_check: bool = False    # log-cadence dp-replica fingerprint
+                                      # check + per-step loss/grad-norm
+                                      # hash chain in metrics_jsonl
 
     # --- eval behaviour: reference evaluates on the TRAIN set (main.py:130, bug §A.1).
     # We default to the test split but keep the knob for log-comparison runs.
@@ -374,7 +382,18 @@ class Config:
         p.add_argument("--collective_stats", action="store_true",
                        help="trace the train step once at startup and "
                             "record its gradient-collective op/byte "
-                            "census to the registry and --metrics_jsonl")
+                            "census (jaxpr + compiled-HLO) to the "
+                            "registry and --metrics_jsonl")
+        p.add_argument("--flight_recorder", type=str, default=None,
+                       help="record span/instant events in a bounded ring "
+                            "and dump them as JSON to this path on any "
+                            "failure path (obs/flight.py)")
+        p.add_argument("--divergence_check", action="store_true",
+                       help="verify dp replicas hold bit-identical params "
+                            "at every log interval (compiled fingerprint "
+                            "pmax-pmin check) and emit a per-step "
+                            "loss/grad-norm hash chain to --metrics_jsonl "
+                            "for bitwise run diffing")
         p.add_argument("--eval_on_train", action="store_true",
                        help="replicate reference bug §A.1 (eval on train split)")
         return p

@@ -13,6 +13,15 @@ Three small host-side layers, none of which touch compiled code:
   points and the trainer's data-wait/step/eval/checkpoint phases.
 - :mod:`.loadgen` — the open-loop Poisson load harness behind
   ``bench.py --serve-load-smoke`` (the ROADMAP-3 load generator).
+- :mod:`.flight` — a bounded ring buffer of structured events fed from
+  the span/instant call sites, dumped as a schema-versioned JSON
+  artifact on every failure path (watchdog, chaos, drain, nonfinite
+  raise, crash hook) — the forensics layer (ISSUE 10).
+- :mod:`.sentinel` — the dp-replica divergence check (u32 fingerprint
+  compared via pmax-pmin inside the mesh) and the per-step hash chain
+  for bitwise run diffing.
+- :mod:`.regress` — ``bench-diff``: stage-by-stage comparison of two
+  bench records gated on each stage's recorded ``spread``.
 
 The whole layer is a no-op when disabled (``metrics.set_enabled(False)``
 or ``DCP_TELEMETRY=0``): record paths return before taking any lock and
@@ -22,14 +31,19 @@ The ``stats``/``waste`` views stay live even when telemetry is off:
 they are functional scheduler counters, not optional diagnostics.
 """
 
-from distributed_compute_pytorch_tpu.obs import loadgen, metrics, tracing
+from distributed_compute_pytorch_tpu.obs import (
+    flight, loadgen, metrics, regress, tracing)
+from distributed_compute_pytorch_tpu.obs.flight import (
+    FlightRecorder, configure_flight, current_flight, dump_on_fault)
 from distributed_compute_pytorch_tpu.obs.metrics import (
     Counter, Gauge, Histogram, MetricDict, Registry, enabled, set_enabled)
 from distributed_compute_pytorch_tpu.obs.tracing import (
     Tracer, configure_tracer, current_tracer, span)
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricDict", "Registry",
-    "Tracer", "configure_tracer", "current_tracer", "enabled",
-    "loadgen", "metrics", "set_enabled", "span", "tracing",
+    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricDict",
+    "Registry", "Tracer", "configure_flight", "configure_tracer",
+    "current_flight", "current_tracer", "dump_on_fault", "enabled",
+    "flight", "loadgen", "metrics", "regress", "set_enabled", "span",
+    "tracing",
 ]

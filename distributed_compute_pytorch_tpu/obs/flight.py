@@ -1,0 +1,261 @@
+"""Flight recorder: a bounded ring of structured events, dumped on faults.
+
+PR 8's spans and histograms show a HEALTHY run; a crashed, hung, or
+drained run dies dark — the watchdog fires, the chaos drill trips, the
+trainer raises on a non-finite loss, and the event history that
+explains WHY is gone with the process. The flight recorder is the
+aviation answer: a fixed-size, thread-safe ring buffer that records
+the last N structured events (admission waves, segment
+dispatch/harvest, collective boundaries, checkpoint writes, nonfinite
+skips, chaos injections) and writes a schema-versioned JSON artifact
+when something goes wrong.
+
+Design points:
+
+- The ring is PREALLOCATED and bounded: ``record()`` is one lock, one
+  dict build, one slot assignment — no allocation growth, no I/O, so
+  it can ride the serve scheduler's hot path. Overwritten events are
+  counted (``dropped`` in the dump), never silently lost.
+- It feeds from the EXISTING span/instant call sites: the module-level
+  ``obs.tracing.span``/``instant`` forward to the installed recorder,
+  so the serve loop's ``admit_wave``/``dispatch_segment``/``harvest``/
+  ``reconstruct``/``fault``/``drain_start`` and the trainer's
+  ``train_step``/``checkpoint``/``eval`` events arrive with ZERO new
+  instrumentation. When no recorder is installed the cost at those
+  sites is one module-attribute read (the PR 8 disabled-path
+  discipline; the deterministic <1% bound in tests covers it).
+- ``dump()`` writes ``{"schema_version", "reason", "fault", "events",
+  "dropped", ...}`` — the artifact a postmortem actually needs: what
+  the scheduler was doing in the seconds before the fault, in order.
+  Dumps are wired to every failure path the repo owns: the serve
+  watchdog timeout / reconstruction / poison eviction (``serve.py
+  handle_fault``), the SIGTERM drain (``police``), the trainer's
+  non-finite ``raise`` (``trainer._poll_nonfinite``), and — via
+  :func:`install_crash_hook` — any unhandled exception at process
+  exit.
+- ``validate_dump()`` is the structural check tests and tooling share:
+  schema version, ordered contiguous sequence numbers, well-formed
+  events.
+
+Like the tracer, the recorder is installed process-globally
+(:func:`configure_flight`) so deeply-nested call sites don't thread a
+handle; per-test isolation is a configure/restore pair.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from distributed_compute_pytorch_tpu.obs import metrics
+
+SCHEMA_VERSION = 1
+
+# default ring capacity: enough for several admission waves' worth of
+# serve events or a few hundred train steps at span granularity, at
+# ~100 bytes/event — a bounded few tens of KB resident
+DEFAULT_CAPACITY = 1024
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring buffer of structured events.
+
+    ``path`` is where :meth:`dump` writes when not given an explicit
+    target (a file path; parent directory must exist). With no path,
+    dumps are returned as dicts only (``last_dump`` keeps the most
+    recent one either way — the hook tests read it).
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 path: str | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.path = path
+        self._mu = threading.Lock()
+        self._ring: list = [None] * capacity
+        self._seq = 0                  # total events ever recorded
+        self._epoch_ns = time.perf_counter_ns()
+        self.last_dump: dict | None = None
+        self.dumps = 0
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event. Near-zero cost: no I/O, no growth; a
+        telemetry-disabled process records nothing (same global switch
+        as counters/histograms/spans)."""
+        if not metrics.enabled():
+            return
+        ev = {"kind": kind,
+              "t_us": (time.perf_counter_ns() - self._epoch_ns) / 1e3,
+              "tid": threading.get_native_id()}
+        if fields:
+            ev.update(fields)
+        with self._mu:
+            ev["seq"] = self._seq
+            self._ring[self._seq % self.capacity] = ev
+            self._seq += 1
+
+    def events(self) -> list[dict]:
+        """The retained events, oldest first (seq-ordered)."""
+        with self._mu:
+            n = self._seq
+            if n <= self.capacity:
+                return [e for e in self._ring[:n]]
+            i = n % self.capacity
+            return self._ring[i:] + self._ring[:i]
+
+    @property
+    def recorded(self) -> int:
+        with self._mu:
+            return self._seq
+
+    def dump(self, reason: str, fault: str | None = None,
+             path: str | None = None, **extra) -> dict:
+        """Write (and return) the schema-versioned dump artifact.
+
+        ``reason`` names the failure path that fired the dump
+        (``serve_fault``, ``sigterm_drain``, ``trainer_nonfinite``,
+        ``unhandled_exception``, ...); ``fault`` carries the error
+        string when there is one. Dump failures never mask the
+        original fault: the write is best-effort, the dict is always
+        returned."""
+        events = self.events()
+        with self._mu:
+            dropped = max(0, self._seq - self.capacity)
+        doc = {"schema_version": SCHEMA_VERSION,
+               "kind": "flight_recorder_dump",
+               "reason": reason,
+               "fault": fault,
+               "ts_unix": time.time(),
+               "pid": os.getpid(),
+               "recorded": len(events) + dropped,
+               "dropped": dropped,
+               "events": events}
+        if extra:
+            doc.update(extra)
+        target = path or self.path
+        if target:
+            try:
+                tmp = f"{target}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                os.replace(tmp, target)   # atomic: never a torn dump
+            except OSError:
+                pass
+        self.last_dump = doc
+        self.dumps += 1
+        return doc
+
+
+def validate_dump(doc: dict) -> list[str]:
+    """Structural validity of a dump artifact: schema version, required
+    fields, and seq-contiguous ordered events. Returns violations
+    (empty == valid) — the shape tests assert on every failure path."""
+    problems: list[str] = []
+    if doc.get("schema_version") != SCHEMA_VERSION:
+        problems.append(f"schema_version {doc.get('schema_version')!r} "
+                        f"!= {SCHEMA_VERSION}")
+    if doc.get("kind") != "flight_recorder_dump":
+        problems.append(f"kind {doc.get('kind')!r}")
+    for key in ("reason", "ts_unix", "pid", "events", "dropped"):
+        if key not in doc:
+            problems.append(f"missing {key!r}")
+    events = doc.get("events")
+    if not isinstance(events, list):
+        return problems + ["events is not a list"]
+    prev = None
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "kind" not in ev or "seq" not in ev:
+            problems.append(f"event {i}: malformed {ev!r}")
+            continue
+        if prev is not None and ev["seq"] != prev + 1:
+            problems.append(f"event {i}: seq {ev['seq']} after {prev} "
+                            f"(not contiguous)")
+        prev = ev["seq"]
+    if (events and isinstance(events[0], dict)
+            and events[0].get("seq", 0) != doc.get("dropped", 0)):
+        problems.append(f"first seq {events[0].get('seq')} != dropped "
+                        f"{doc.get('dropped')}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# process-global recorder (the tracing._GLOBAL pattern): instrumented
+# code pays one module read when no recorder is installed
+# ---------------------------------------------------------------------------
+
+_GLOBAL: FlightRecorder | None = None
+
+
+def configure_flight(recorder: FlightRecorder | None
+                     ) -> FlightRecorder | None:
+    """Install (or clear, with ``None``) the process-global recorder;
+    returns the previous one so tests can restore."""
+    global _GLOBAL
+    prev = _GLOBAL
+    _GLOBAL = recorder
+    return prev
+
+
+def current_flight() -> FlightRecorder | None:
+    return _GLOBAL
+
+
+def record(kind: str, **fields) -> None:
+    """Record into the global recorder, or do nothing — the form the
+    span/instant feed and the failure-path call sites use."""
+    r = _GLOBAL
+    if r is not None:
+        r.record(kind, **fields)
+
+
+def dump_on_fault(reason: str, fault: str | None = None, **extra
+                  ) -> dict | None:
+    """Dump the global recorder (no-op without one). Every wired
+    failure path funnels here, so the call sites stay one line and a
+    missing recorder costs one read."""
+    r = _GLOBAL
+    if r is None:
+        return None
+    return r.dump(reason, fault=fault, **extra)
+
+
+# ---------------------------------------------------------------------------
+# crash hook: unhandled exceptions dump the ring before the process dies
+# ---------------------------------------------------------------------------
+
+_hook_installed = False
+
+
+def install_crash_hook() -> None:
+    """Chain an excepthook that dumps the flight ring on any unhandled
+    exception, plus an atexit fallback that dumps a fault-bearing ring
+    that never reached a dump (e.g. ``os._exit`` paths skip
+    excepthook). Idempotent; only ever wraps once."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    _hook_installed = True
+    prev = sys.excepthook
+
+    def hook(tp, val, tb):
+        try:
+            record("unhandled_exception", error=f"{tp.__name__}: {val}")
+            dump_on_fault("unhandled_exception",
+                          fault=f"{tp.__name__}: {val}")
+        finally:
+            prev(tp, val, tb)
+
+    sys.excepthook = hook
+
+    import atexit
+
+    def _atexit_dump():
+        r = _GLOBAL
+        if r is not None and r.dumps == 0 and r.recorded > 0:
+            r.dump("atexit")
+
+    atexit.register(_atexit_dump)

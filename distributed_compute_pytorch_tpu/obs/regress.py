@@ -1,0 +1,185 @@
+"""bench-diff: gate the bench trajectory on its own recorded noise.
+
+BENCH_r*.json is a write-only log today: every run appends a record,
+nobody compares two. The classic failure is a perf regression that is
+real but smaller than eyeball noise — characterization studies of
+distributed training (arXiv:1810.11112) make the point that without a
+noise model, trajectory comparisons are either too twitchy (every run
+flags) or too blind (only 2x shows). We already HAVE a noise model:
+`bench.py` times every stage with `_two_length_dt`, which records a
+``spread`` — the relative disagreement between its two timing runs —
+next to every derived number. That spread is a measured, same-machine,
+same-run noise floor for exactly the quantity it annotates.
+
+The gate therefore flags metric M as a regression iff it moved in the
+BAD direction by more than ``max(spread_base, spread_new, floor) *
+margin`` — i.e. by more than the benchmark itself admits it cannot
+resolve, times a safety margin. Metrics whose good-direction is not
+derivable from the key (counts, configuration echoes) are reported as
+informational changes, never gated: a gate that guesses directions
+produces false reds, and false reds train people to ignore it.
+
+Inputs are any of: a bare bench record (one compact JSON object, as
+`bench.py` and its smokes print), a BENCH_r*.json wrapper (``parsed``
+holds the record, ``tail`` the raw stdout), or a log file whose last
+JSON line is the record — so the historical trajectory diffs with no
+preprocessing. ``schema_version`` (stamped by bench.py from this PR
+on) is carried into the report; version skew is a warning, not an
+error, since the stage-key layout is append-only.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+SCHEMA_VERSION = 1
+
+# minimum noise floor (relative): two-run spreads on sub-ms stages can
+# be luckily tiny; never gate tighter than 2%
+ABS_FLOOR = 0.02
+
+DEFAULT_MARGIN = 2.0
+
+# good-direction by key suffix/substring. Deliberately short and
+# documented: a key matching neither list is never gated.
+LOWER_IS_BETTER = ("_ms", "_s", "_us", "_ns", "_bytes", "wall",
+                   "latency", "overhead", "dropped", "waste", "miss",
+                   "p50", "p90", "p95", "p99")
+HIGHER_IS_BETTER = ("per_s", "per_sec", "tok_s", "mfu", "speedup",
+                    "goodput", "hit_rate", "throughput", "samples_sec",
+                    "value")
+
+# keys that are structure, not measurement
+SKIP_KEYS = {"schema_version", "spread", "metric", "unit", "kind",
+             "details_file", "device_kind", "checks"}
+
+
+def load_record(path: str) -> dict:
+    """A bench record from any historical artifact shape: bare record,
+    BENCH_r wrapper (``parsed``), or last-JSON-line of a log."""
+    with open(path) as f:
+        text = f.read()
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: no JSON record found")
+    if isinstance(doc.get("parsed"), dict):      # BENCH_r wrapper
+        doc = doc["parsed"]
+    return doc
+
+
+def flatten(rec: dict) -> dict[str, tuple[float, float]]:
+    """``{dotted_key: (value, spread)}`` over every numeric leaf, where
+    ``spread`` is the nearest enclosing dict's recorded ``spread`` (the
+    stage's own noise floor), 0.0 when none is in scope."""
+    out: dict[str, tuple[float, float]] = {}
+
+    def walk(node, prefix, spread):
+        if isinstance(node, dict):
+            s = node.get("spread")
+            if isinstance(s, (int, float)) and not isinstance(s, bool):
+                spread = float(s)
+            for k, v in node.items():
+                if k in SKIP_KEYS:
+                    continue
+                walk(v, f"{prefix}.{k}" if prefix else k, spread)
+        elif isinstance(node, bool):
+            return
+        elif isinstance(node, (int, float)):
+            out[prefix] = (float(node), spread)
+
+    walk(rec, "", 0.0)
+    return out
+
+
+def direction(key: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (not gated).
+    Higher-is-better wins ties: rate patterns are MORE specific than
+    the unit suffixes they end in (``tok_per_s`` contains ``_s``; a
+    latency key never contains a rate pattern)."""
+    leaf = key.rsplit(".", 1)[-1]
+    for pat in HIGHER_IS_BETTER:
+        if leaf.endswith(pat) or pat in leaf:
+            return +1
+    for pat in LOWER_IS_BETTER:
+        if leaf.endswith(pat) or pat in leaf:
+            return -1
+    return 0
+
+
+def diff_records(base: dict, new: dict,
+                 margin: float = DEFAULT_MARGIN) -> dict:
+    """Stage-by-stage comparison. A key regresses iff it moved the bad
+    way by more than its own noise floor x margin; the floor is the
+    larger of the two runs' recorded spreads, never below ABS_FLOOR."""
+    fb, fn = flatten(base), flatten(new)
+    regressions, improvements, changed = [], [], []
+    for key in sorted(fb.keys() & fn.keys()):
+        (vb, sb), (vn, sn) = fb[key], fn[key]
+        if vb == vn:
+            continue
+        denom = abs(vb) if vb else abs(vn)
+        if denom == 0:
+            continue
+        rel = (vn - vb) / denom
+        floor = max(sb, sn, ABS_FLOOR) * margin
+        d = direction(key)
+        entry = {"key": key, "base": vb, "new": vn,
+                 "rel_change": round(rel, 4), "floor": round(floor, 4)}
+        if d == 0 or abs(rel) <= floor:
+            if abs(rel) > floor:
+                changed.append(entry)
+            continue
+        (improvements if rel * d > 0 else regressions).append(entry)
+    return {"schema_version": SCHEMA_VERSION,
+            "kind": "bench_diff",
+            "base_schema": base.get("schema_version"),
+            "new_schema": new.get("schema_version"),
+            "margin": margin,
+            "compared": len(fb.keys() & fn.keys()),
+            "only_base": sorted(fb.keys() - fn.keys()),
+            "only_new": sorted(fn.keys() - fb.keys()),
+            "regressions": regressions,
+            "improvements": improvements,
+            "changed": changed}
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``bench-diff BASE NEW [--margin M]`` — prints the report, exits
+    1 on any regression (the make-gate contract), 2 on unusable input."""
+    args = list(sys.argv[1:] if argv is None else argv)
+    margin = DEFAULT_MARGIN
+    if "--margin" in args:
+        i = args.index("--margin")
+        margin = float(args[i + 1])
+        del args[i:i + 2]
+    if len(args) != 2:
+        print("usage: bench-diff BASE NEW [--margin M]", file=sys.stderr)
+        return 2
+    try:
+        base, new = load_record(args[0]), load_record(args[1])
+    except (OSError, ValueError) as e:
+        print(f"bench-diff: {e}", file=sys.stderr)
+        return 2
+    report = diff_records(base, new, margin=margin)
+    print(json.dumps(report))
+    for r in report["regressions"]:
+        print(f"REGRESSION {r['key']}: {r['base']} -> {r['new']} "
+              f"({r['rel_change']:+.1%}, floor ±{r['floor']:.1%})",
+              file=sys.stderr)
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

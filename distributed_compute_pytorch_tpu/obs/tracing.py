@@ -39,7 +39,7 @@ import os
 import threading
 import time
 
-from distributed_compute_pytorch_tpu.obs import metrics
+from distributed_compute_pytorch_tpu.obs import flight, metrics
 
 
 class _NullSpan:
@@ -150,7 +150,17 @@ def current_tracer() -> Tracer | None:
 def span(name: str, **args):
     """Module-level span against the global tracer — the form the serve
     scheduler and trainer call. No tracer (or telemetry disabled) means
-    the shared null context: one global read, zero allocation."""
+    the shared null context: one global read, zero allocation.
+
+    Also the flight recorder's feed point: every span/instant name that
+    flows through here lands in the installed
+    :mod:`~distributed_compute_pytorch_tpu.obs.flight` ring, so the
+    recorder sees the scheduler's event stream with no extra
+    instrumentation. The flight recorder works without a tracer (and
+    vice versa) — the two checks are independent."""
+    f = flight._GLOBAL
+    if f is not None:
+        f.record(name, **args)
     t = _GLOBAL
     if t is None or not metrics.enabled():
         return _NULL_SPAN
@@ -158,6 +168,9 @@ def span(name: str, **args):
 
 
 def instant(name: str, **args) -> None:
+    f = flight._GLOBAL
+    if f is not None:
+        f.record(name, **args)
     t = _GLOBAL
     if t is None or not metrics.enabled():
         return

@@ -178,7 +178,9 @@ from distributed_compute_pytorch_tpu.core.mesh import (
 from distributed_compute_pytorch_tpu.infer import (
     _CACHE_SPEC, _POOL_SPEC, sample_rows)
 from distributed_compute_pytorch_tpu.kv_pool import BlockPool, RadixCache
+from distributed_compute_pytorch_tpu.obs import flight
 from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
+from distributed_compute_pytorch_tpu.obs.metrics import device_memory_gauges
 from distributed_compute_pytorch_tpu.obs.tracing import instant, span
 from distributed_compute_pytorch_tpu.serve_lifecycle import (
     CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
@@ -549,6 +551,11 @@ class ContinuousBatcher:
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
             "block_leaks": self.last_block_leaks,
+            # device memory at snapshot time ({} on CPU/no stats): the
+            # heartbeat is often the ONLY live signal a long serve run
+            # emits, so HBM pressure must ride it, not just the trainer
+            # log cadence
+            "mem": device_memory_gauges(self.obs, prefix="serve.mem."),
         }
 
     def profile_next(self, segments: int, profile_dir: str) -> None:
@@ -1056,6 +1063,10 @@ class ContinuousBatcher:
                     and not draining["on"]):
                 draining["on"] = True
                 instant("drain_start", queued=len(queue))
+                # a preempting host may never reach a clean exit — dump
+                # the ring the moment the SIGTERM latch is observed
+                flight.dump_on_fault("sigterm_drain",
+                                     queued=len(queue))
                 if drain_deadline_s is not None:
                     draining["deadline"] = now + drain_deadline_s
                 for i in list(queue):
@@ -1307,9 +1318,15 @@ class ContinuousBatcher:
             wedging or crashing the process."""
             self.stats["faults"] += 1
             fault_state["consecutive"] += 1
+            fault_state["last_error"] = err = f"{type(e).__name__}: {e}"
             t_fault = time.monotonic()
-            err = f"{type(e).__name__}: {e}"
             instant("fault", error=err)
+            # the forensic moment: the ring now holds the event history
+            # leading up to this fault (instant("fault") above included)
+            flight.dump_on_fault(
+                "serve_fault", fault=err,
+                consecutive=fault_state["consecutive"],
+                recoveries=fault_state["recoveries"])
             if fault_state["recoveries"] >= self.max_recoveries:
                 msg = (f"device lost after {fault_state['recoveries']} "
                        f"recovery attempt(s) ({err})")
@@ -1326,11 +1343,14 @@ class ContinuousBatcher:
                 live = [b for b, s in enumerate(table) if s.req_index >= 0]
                 if live:
                     victim = max(live, key=lambda b: table[b].admit_seq)
+                    instant("poison_eviction",
+                            request=table[victim].req_index, error=err)
                     fin(table[victim].req_index, FAILED,
                         table[victim].out,
                         f"evicted as suspected poison row after "
                         f"repeated faults ({err})")
                     free_row(victim)
+                    flight.dump_on_fault("poison_eviction", fault=err)
             for slot in table:
                 if slot.req_index >= 0:
                     recs[slot.req_index] += 1
@@ -1423,6 +1443,17 @@ class ContinuousBatcher:
         for i in range(n):
             if results[i] is None:
                 fin(i, FAILED, [], "not served (scheduler bug)")
+        # a session that saw faults or chaos trips gets a final dump
+        # even when every fault was absorbed without raising ("slow"
+        # chaos never reaches handle_fault; a recovered session's
+        # per-fault dumps would otherwise be the only record)
+        if self.stats["faults"] > 0 or (chaos is not None
+                                        and chaos.trips > 0):
+            flight.dump_on_fault(
+                "serve_session_end",
+                fault=fault_state.get("last_error"),
+                faults=self.stats["faults"],
+                chaos_trips=chaos.trips if chaos is not None else 0)
         return results
 
     # ---- admission / recovery waves ---------------------------------------

@@ -48,6 +48,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from distributed_compute_pytorch_tpu.obs import flight
+
 # terminal request states (RequestResult.status)
 OK = "ok"
 FAILED = "failed"
@@ -178,6 +180,7 @@ class ChaosInjector:
             if (self.trips < self.fault_count
                     and self.poison_request in plan_requests):
                 self.trips += 1
+                self._record(segments)
                 raise InjectedFault(
                     f"injected poison row (request {self.poison_request}) "
                     f"at segment {segments}")
@@ -186,9 +189,11 @@ class ChaosInjector:
             return
         if self.fault_mode == "raise":
             self.trips += 1
+            self._record(segments)
             raise InjectedFault(f"injected tick fault at segment {segments}")
         if self.fault_mode == "slow":
             self.trips += 1
+            self._record(segments)
             time.sleep(self.slow_s)
 
     def in_fetch(self, segments: int) -> None:
@@ -196,4 +201,12 @@ class ChaosInjector:
         only), so the watchdog observes a genuinely blocked fetch."""
         if self.fault_mode == "hang" and self._armed(segments):
             self.trips += 1
+            self._record(segments)
             time.sleep(self.hang_s)
+
+    def _record(self, segments: int) -> None:
+        # chaos trips land in the flight ring even for the modes that
+        # never raise (slow/hang) — the dump must name the injected
+        # fault no matter how the run ends
+        flight.record("chaos_injection", mode=self.fault_mode,
+                      segment=segments, trip=self.trips)

@@ -76,7 +76,8 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                   augment=None, shard_update: bool | None = None,
                   quant_collectives: bool = False, accum_steps: int = 1,
                   accum_dtype=None, accum_bucket_mb: float | None = None,
-                  nonfinite_policy: str = "raise"):
+                  nonfinite_policy: str = "raise",
+                  sentinel: bool = False):
     """Build ``(init_fn, train_step, eval_step)`` for ``model`` on ``mesh``.
 
     ``strategy`` decides parameter layout (default pure DP = replicated,
@@ -151,11 +152,23 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
     Incompatible with ``quant_collectives`` (the gradients live inside
     its manual region with quantized wire values; guard there would
     check the wrong numbers).
+
+    ``sentinel`` — adds ``metrics["grad_sumsq"]`` (the same f32 global
+    gradient sum-of-squares the skip guard checks) to every step's
+    metrics, feeding the trainer's per-step loss/grad-norm hash chain
+    (``obs/sentinel.py``) for bitwise run diffing. Free when the skip
+    guard is on (the scalar already exists); one extra fused reduction
+    per leaf otherwise. Not available under ``quant_collectives``
+    (same reason as the guard) — the chain falls back to loss-only.
     """
     if nonfinite_policy not in ("raise", "skip"):
         raise ValueError(f"nonfinite_policy must be 'raise' or 'skip', "
                          f"got {nonfinite_policy!r}")
     skip_guard = nonfinite_policy == "skip"
+    # the sentinel's grad_sumsq metric rides the skip guard's scalar
+    # when both are on; quant_collectives cannot surface it (gradients
+    # exist only quantized inside the manual region)
+    need_gn2 = skip_guard or (sentinel and not quant_collectives)
     if skip_guard and quant_collectives:
         raise ValueError(
             "nonfinite_policy 'skip' does not compose with "
@@ -535,7 +548,7 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                 gsum, o, p, p_specs, buckets,
                 reduce_leaf=reduce_leaf, slice_leaf=slice_leaf,
                 gather_leaf=gather_leaf, update_fn=_local_update)
-            if skip_guard:
+            if need_gn2:
                 # per-rank LOCAL grad sum-of-squares, psum'd: non-finite
                 # on any rank => non-finite here (the reduced gradient
                 # inherits it), so the outer guard sees every divergence
@@ -545,7 +558,7 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         repl_p = jax.tree.map(lambda _: P(), params)
         out_specs = (repl_p, o_specs, repl_ms, P())
-        if skip_guard:
+        if need_gn2:
             out_specs = out_specs + (P(),)
         fn = shard_map(body, mesh=mesh,
                        in_specs=(repl_p, o_specs, repl_ms,
@@ -591,7 +604,7 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
                            / accum_steps).astype(pl.dtype),
             gsum, state.params)
         new_p, new_o = _local_update(grads, state.opt_state, state.params)
-        gn2 = _grad_sumsq(gsum) if skip_guard else None
+        gn2 = _grad_sumsq(gsum) if need_gn2 else None
         return new_p, new_o, new_ms, jnp.mean(losses), gn2
 
     def _guarded(state: TrainState, new_params, new_opt_state,
@@ -631,6 +644,8 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
             new_params, new_opt_state, new_mstate, loss, gn2 = step_fn(
                 state, x, y, step_rng)
             metrics = {"loss": loss.astype(jnp.float32)}
+            if sentinel and gn2 is not None:
+                metrics["grad_sumsq"] = gn2.astype(jnp.float32)
             if skip_guard:
                 return _guarded(state, new_params, new_opt_state,
                                 new_mstate, loss, gn2, metrics)
@@ -681,17 +696,21 @@ def make_step_fns(model, tx: optax.GradientTransformation, mesh: Mesh,
             else:
                 new_params, new_opt_state = _local_update(
                     grads, state.opt_state, state.params)
+            gn2 = _grad_sumsq(grads) if need_gn2 else None
             if skip_guard:
                 metrics = {"loss": loss.astype(jnp.float32)}
+                if sentinel:
+                    metrics["grad_sumsq"] = gn2.astype(jnp.float32)
                 return _guarded(state, new_params, new_opt_state,
-                                new_mstate, loss, _grad_sumsq(grads),
-                                metrics)
+                                new_mstate, loss, gn2, metrics)
         new_state = state.replace(
             step=state.step + 1, params=new_params,
             model_state=new_mstate, opt_state=new_opt_state)
         # global mean loss (the reference logs the SUM over ranks, a
         # world-size-scaled number — SURVEY §A.4; we fix to the mean)
         metrics = {"loss": loss.astype(jnp.float32)}
+        if sentinel and not quant_collectives:
+            metrics["grad_sumsq"] = gn2.astype(jnp.float32)
         return new_state, metrics
 
     @jax.jit

@@ -30,6 +30,7 @@ from distributed_compute_pytorch_tpu.data.loader import (
     DeviceFeeder, StreamingDeviceFeeder)
 from distributed_compute_pytorch_tpu.data.shards import ShardedFileDataset
 from distributed_compute_pytorch_tpu.models.registry import build_model
+from distributed_compute_pytorch_tpu.obs import flight
 from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
 from distributed_compute_pytorch_tpu.obs.tracing import (
     Tracer, configure_tracer, span)
@@ -152,13 +153,18 @@ class Trainer:
             quant_collectives=config.quant_collectives,
             accum_steps=self.accum, accum_dtype=accum_dtype,
             accum_bucket_mb=config.accum_bucket_mb,
-            nonfinite_policy=config.nonfinite_policy)
+            nonfinite_policy=config.nonfinite_policy,
+            sentinel=(config.divergence_check
+                      and not config.quant_collectives))
         # non-finite guard bookkeeping (train/step.py nonfinite_policy):
         # per-step skip flags queue as DEVICE scalars and are only read
         # at the log cadence — no per-step host sync on the hot path
         self._skip_hist: list = []
         self._skips_total = 0
         self._skips_consec = 0
+        # hash-chain scalars queue the same way (obs/sentinel.py): per
+        # step (loss, grad_sumsq) device scalars, folded at log cadence
+        self._chain_pending: list = []
         # interleaved-pipeline runs keep the LIVE state's blocks in the
         # strided storage layout; checkpoints stay logical — these
         # converters sit at the save/restore boundaries (None otherwise)
@@ -266,6 +272,24 @@ class Trainer:
                                      and is_coordinator()) else None)
         if self._tracer is not None:
             configure_tracer(self._tracer)
+        # flight recorder (ISSUE 10, obs/flight.py): bounded ring of the
+        # span/instant event stream, dumped to --flight_recorder PATH on
+        # every failure path; the crash hook covers unhandled exceptions
+        self._flight = None
+        if config.flight_recorder:
+            self._flight = flight.FlightRecorder(
+                path=config.flight_recorder)
+            flight.configure_flight(self._flight)
+            flight.install_crash_hook()
+        # divergence sentinel (obs/sentinel.py): compiled cross-replica
+        # fingerprint check + per-step hash chain, both at log cadence;
+        # None when the mesh has no dp replication to check
+        self._div_check = None
+        self._hash_chain = None
+        if config.divergence_check:
+            from distributed_compute_pytorch_tpu.obs import sentinel
+            self._div_check = sentinel.make_divergence_check(self.mesh)
+            self._hash_chain = sentinel.HashChain()
         # --collective_stats: census the step's gradient collectives ONCE,
         # at the first batch (needs concrete args to trace against)
         self._collective_stats_done = not config.collective_stats
@@ -415,6 +439,10 @@ class Trainer:
                 configure_tracer(None)
                 self._tracer.close()
                 self._tracer = None
+        if self._flight is not None and flight.current_flight() is self._flight:
+            # uninstall OUR recorder (another run may install its own);
+            # failure paths have already dumped by the time we get here
+            flight.configure_flight(None)
         self.logger.close()
 
     def train_epoch(self, epoch: int, skip: int = 0,
@@ -446,11 +474,17 @@ class Trainer:
             if "skipped" in metrics:
                 # device scalar, queued unread: fetched at log cadence
                 self._skip_hist.append(metrics["skipped"])
+            if self._hash_chain is not None:
+                # same discipline: queue the device scalars, fold at
+                # cadence — the chain costs the hot path nothing
+                self._chain_pending.append(
+                    (metrics["loss"], metrics.get("grad_sumsq")))
             if b % cfg.log_every == 0:
                 # read the device scalar only at the logging cadence
                 # (reference cadence, main.py:64)
                 loss = float(metrics["loss"])
                 self._poll_nonfinite(loss, epoch, b)
+                self._poll_divergence(epoch, b)
                 self.logger.train_line(epoch, b, steps, loss)
                 mem = obs_metrics.device_memory_gauges(obs_metrics.REGISTRY)
                 if mem:
@@ -474,6 +508,7 @@ class Trainer:
             # drain the skip flags queued since the last log line, so an
             # epoch can't end with unexamined non-finite skips
             self._poll_nonfinite(float(metrics["loss"]), epoch, steps - 1)
+            self._poll_divergence(epoch, steps - 1)
         secs = timer.elapsed()
         # each update consumes the full effective batch (micro x accum)
         return (steps - skip) * cfg.batch_size * self.accum / secs
@@ -501,21 +536,61 @@ class Trainer:
                     self._skips_consec = 0
             self._skip_hist.clear()
             if new_skips:
+                flight.record("nonfinite_skip", epoch=epoch, step=b,
+                              count=new_skips, total=self._skips_total)
                 log0(f"nonfinite_policy=skip: skipped {new_skips} "
                      f"non-finite update(s) near epoch {epoch} step {b} "
                      f"(total {self._skips_total}, consecutive "
                      f"{self._skips_consec})")
             if self._skips_consec >= NONFINITE_SKIP_LIMIT:
-                raise RuntimeError(
-                    f"{self._skips_consec} consecutive non-finite "
-                    f"updates skipped (epoch {epoch} step {b}): the run "
-                    f"has diverged — params are still the last finite "
-                    f"state; lower the lr or clip gradients")
+                msg = (f"{self._skips_consec} consecutive non-finite "
+                       f"updates skipped (epoch {epoch} step {b}): the "
+                       f"run has diverged — params are still the last "
+                       f"finite state; lower the lr or clip gradients")
+                flight.record("nonfinite_abort", epoch=epoch, step=b,
+                              consecutive=self._skips_consec)
+                flight.dump_on_fault("trainer_nonfinite", fault=msg)
+                raise RuntimeError(msg)
         elif not math.isfinite(loss):
-            raise RuntimeError(
-                f"non-finite loss {loss} at epoch {epoch} step {b} "
-                f"(nonfinite_policy=raise); use --nonfinite_policy skip "
-                f"to drop bad updates instead of aborting")
+            msg = (f"non-finite loss {loss} at epoch {epoch} step {b} "
+                   f"(nonfinite_policy=raise); use --nonfinite_policy "
+                   f"skip to drop bad updates instead of aborting")
+            flight.record("nonfinite_abort", epoch=epoch, step=b,
+                          loss=loss)
+            flight.dump_on_fault("trainer_nonfinite", fault=msg)
+            raise RuntimeError(msg)
+
+    def _poll_divergence(self, epoch: int, b: int) -> None:
+        """Log-cadence sentinel work (``--divergence_check``): fold the
+        queued per-step (loss, grad_sumsq) scalars into the hash chain,
+        emit the digest to the metrics JSONL, then run the compiled
+        cross-replica fingerprint check. A nonzero spread means the dp
+        replicas no longer hold bit-identical params — silent data
+        corruption caught within one log interval instead of surfacing
+        as an unexplained loss explosion later (obs/sentinel.py)."""
+        if self._hash_chain is None:
+            return
+        for loss_d, gsq_d in self._chain_pending:
+            vals = (float(loss_d),) + (
+                (float(gsq_d),) if gsq_d is not None else ())
+            self._hash_chain.update(*vals)
+        self._chain_pending.clear()
+        self.logger.telemetry("hash_chain", {
+            "epoch": epoch, "step": b, "steps": self._hash_chain.steps,
+            "digest": self._hash_chain.digest()})
+        if self._div_check is None:
+            return
+        with span("divergence_check"):
+            spread = self._div_check(self.state.params)
+        if spread != 0:
+            msg = (f"dp replicas diverged at epoch {epoch} step {b}: "
+                   f"param fingerprint spread {spread} (expected 0) — "
+                   f"silent corruption or a nondeterministic kernel; "
+                   f"restore from the last checkpoint")
+            flight.record("replica_divergence", epoch=epoch, step=b,
+                          spread=int(spread))
+            flight.dump_on_fault("replica_divergence", fault=msg)
+            raise RuntimeError(msg)
 
     def _should_preempt(self, guard, global_step: int) -> bool:
         """Per-step preemption poll. Single-host: the local signal flag.
@@ -556,7 +631,7 @@ class Trainer:
             return
         self._collective_stats_done = True
         from distributed_compute_pytorch_tpu.parallel.collectives import (
-            grad_collective_stats)
+            grad_collective_stats, hlo_collectives)
         try:
             stats = grad_collective_stats(self.train_step, self.state, x, y)
         except Exception as e:   # noqa: BLE001 — diagnostics must not kill a run
@@ -564,9 +639,25 @@ class Trainer:
             return
         for k, v in stats.items():
             obs_metrics.REGISTRY.gauge(f"collectives.grad.{k}").set(v)
-        self.logger.telemetry("collectives", {"grad": stats})
+        # post-compile HLO census: the jaxpr walk above reports 0 on the
+        # pure SPMD-jit path (the partitioner inserts its collectives
+        # DURING compilation); counting the compiled module's ops closes
+        # that gap. Guarded the same way — HLO text is compiler-internal
+        hlo = None
+        try:
+            hlo = hlo_collectives(self.train_step, self.state, x, y)
+        except Exception as e:   # noqa: BLE001
+            log0(f"WARNING: --collective_stats HLO census failed: {e}")
+        if hlo is not None:
+            obs_metrics.REGISTRY.gauge("collectives.hlo.count").set(
+                hlo["count"])
+            obs_metrics.REGISTRY.gauge("collectives.hlo.bytes").set(
+                hlo["bytes"])
+        self.logger.telemetry("collectives", {"grad": stats, "hlo": hlo})
         log0(f"grad collectives per update: {stats['boundary']} boundary, "
-             f"{stats['in_loop']} in-loop, {stats['bytes']} bytes/chip")
+             f"{stats['in_loop']} in-loop, {stats['bytes']} bytes/chip"
+             + (f" | compiled HLO: {hlo['count']} collective op(s), "
+                f"{hlo['bytes']} bytes ({hlo['ops']})" if hlo else ""))
 
     def evaluate(self, epoch: int,
                  guard: PreemptionGuard | None = None) -> dict:
