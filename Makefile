@@ -25,7 +25,7 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
-	serve-load-smoke bench-diff
+	serve-load-smoke serve-router-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -65,6 +65,13 @@ bench:
 #   tokens are identical to the unloaded path, no slot/block leaks,
 #   the span trace validates as Chrome-trace JSON, and the disabled-
 #   telemetry record path costs < 1% of a segment wall
+# - serve-router: the replica-set drill — the same Poisson stream
+#   offered to 1 and 3 router replicas (each harvest carrying an 80 ms
+#   injected device-latency sleep the replica threads overlap), then
+#   to 3 replicas with one killed mid-stream; fails unless 3-replica
+#   goodput scales > 1.5x, goodput stays > 0 through the kill with
+#   every stream token-identical to the unloaded single-replica
+#   reference, sessions migrate, and no survivor leaks a slot/block
 # - bench-diff (last): the regression gate's self-test — one smoke's
 #   record diffed against itself through obs/regress.py must pass
 #   (a gate that flags identical runs is broken)
@@ -75,6 +82,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
 	$(MAKE) bench-diff
 
 # the bench-regression gate (obs/regress.py): BASE/NEW default to a
@@ -98,3 +106,6 @@ serve-prefix-smoke:
 
 serve-load-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
+
+serve-router-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke

@@ -136,7 +136,19 @@ def main(argv=None) -> int:
                    help="request file ('-' = stdin), one request per "
                         "line (see module docstring for formats)")
     p.add_argument("--slots", type=int, default=8,
-                   help="cache rows decoding concurrently")
+                   help="cache rows decoding concurrently (per replica)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through a replica set: N independent "
+                        "batcher replicas behind the health-checked "
+                        "router (serve_router.ServeRouter) — radix-"
+                        "affinity + least-loaded dispatch, circuit "
+                        "breakers, and failover-by-migration when a "
+                        "replica dies. 1 (default) = the single-batcher "
+                        "path, unchanged")
+    p.add_argument("--fault_replica", type=int, default=0,
+                   help="with --replicas > 1, which replica the "
+                        "injected --fault_at_segment chaos targets "
+                        "(drills failover-by-migration)")
     p.add_argument("--t_max", type=int, default=None,
                    help="cache length == total tick horizon (default: "
                         "sized from the workload)")
@@ -256,6 +268,20 @@ def main(argv=None) -> int:
     if args.temperature == 0.0 and (args.top_k is not None
                                     or args.top_p is not None):
         raise SystemExit("--top_k/--top_p require --temperature > 0")
+    if args.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
+    if args.replicas > 1 and args.mesh is not None:
+        raise SystemExit("--replicas > 1 with --mesh is not supported "
+                         "from this CLI: each replica would need its own "
+                         "mesh (one process drives one device set); run "
+                         "replicated-sharded serving programmatically "
+                         "via serve_router.ServeRouter")
+    if args.replicas > 1 and args.profile_segments is not None:
+        raise SystemExit("--profile_segments profiles one batcher; "
+                         "not supported with --replicas > 1")
+    if not 0 <= args.fault_replica < args.replicas:
+        raise SystemExit(f"--fault_replica {args.fault_replica} outside "
+                         f"[0, {args.replicas})")
     # SIGTERM/SIGINT -> graceful drain, armed BEFORE the heavy imports /
     # checkpoint load / compiles so a preemption at ANY point of startup
     # drains instead of dying mid-load (the trainer's PreemptionGuard,
@@ -328,26 +354,42 @@ def main(argv=None) -> int:
         flight.install_crash_hook()
     metrics_f = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
 
-    def on_heartbeat(snap):
-        line = json.dumps({"kind": "serve_heartbeat", "ts": time.time(),
-                           **snap})
+    def on_heartbeat(snap, replica=None):
+        rec = {"kind": "serve_heartbeat", "ts": time.time()}
+        if replica is not None:
+            rec["replica"] = replica
+        line = json.dumps({**rec, **snap})
         print(line, file=sys.stderr, flush=True)
         if metrics_f is not None:
             metrics_f.write(line + "\n")
             metrics_f.flush()
 
-    cb = ContinuousBatcher(model, params, slots=args.slots, t_max=t_max,
-                           prompt_buf=prompt_buf, segment=args.segment,
-                           eos_id=args.eos_id, mesh=mesh,
-                           admit_policy=args.admit_policy,
-                           max_pending=args.max_pending,
-                           tick_timeout_s=args.tick_timeout,
-                           max_recoveries=args.max_recoveries,
-                           kv_block_tokens=args.kv_block_tokens,
-                           prefix_cache=args.prefix_cache,
-                           heartbeat_s=args.heartbeat or None,
-                           on_heartbeat=(on_heartbeat if args.heartbeat
-                                         else None))
+    def build_batcher(replica=None):
+        hb_cb = None
+        if args.heartbeat:
+            hb_cb = (on_heartbeat if replica is None else
+                     (lambda snap, _r=replica: on_heartbeat(snap, _r)))
+        return ContinuousBatcher(
+            model, params, slots=args.slots, t_max=t_max,
+            prompt_buf=prompt_buf, segment=args.segment,
+            eos_id=args.eos_id, mesh=mesh,
+            admit_policy=args.admit_policy,
+            max_pending=args.max_pending,
+            tick_timeout_s=args.tick_timeout,
+            max_recoveries=args.max_recoveries,
+            kv_block_tokens=args.kv_block_tokens,
+            prefix_cache=args.prefix_cache,
+            heartbeat_s=args.heartbeat or None,
+            on_heartbeat=hb_cb)
+
+    router = None
+    if args.replicas > 1:
+        from distributed_compute_pytorch_tpu.serve_router import ServeRouter
+        router = ServeRouter([build_batcher(i)
+                              for i in range(args.replicas)])
+        cb = router.replicas[0]        # profile/SIGUSR1 target
+    else:
+        cb = build_batcher()
 
     if args.profile_segments is not None:
         # on-demand window (first N segments now; SIGUSR1 re-arms). The
@@ -379,22 +421,32 @@ def main(argv=None) -> int:
     try:
         with maybe_profile(whole_run_profile):
             try:
-                results = cb.serve_detailed(
-                    [Request(list(r["tokens"]), r["max_new"],
-                             temperature=r["temperature"], top_k=r["top_k"],
-                             top_p=r["top_p"], seed=req_seed(i, r),
-                             deadline_s=r["deadline"])
-                     for i, r in enumerate(reqs)],
-                    drain=guard, drain_deadline_s=args.drain_deadline,
-                    chaos=chaos)
+                requests = [Request(list(r["tokens"]), r["max_new"],
+                                    temperature=r["temperature"],
+                                    top_k=r["top_k"],
+                                    top_p=r["top_p"], seed=req_seed(i, r),
+                                    deadline_s=r["deadline"])
+                            for i, r in enumerate(reqs)]
+                if router is not None:
+                    results = router.route(
+                        requests, drain=guard,
+                        drain_deadline_s=args.drain_deadline,
+                        chaos=({args.fault_replica: chaos}
+                               if chaos is not None else None))
+                else:
+                    results = cb.serve_detailed(
+                        requests, drain=guard,
+                        drain_deadline_s=args.drain_deadline, chaos=chaos)
             finally:
                 guard.__exit__()
     finally:
         # telemetry flushes on EVERY exit path (drain, fault, Ctrl-C x2)
         if metrics_f is not None:
+            snap = (router.stats_snapshot() if router is not None
+                    else cb.stats_snapshot())
             metrics_f.write(json.dumps({"kind": "serve_final",
                                         "ts": time.time(),
-                                        **cb.stats_snapshot()}) + "\n")
+                                        **snap}) + "\n")
             metrics_f.close()
         if tracer is not None:
             configure_tracer(None)
@@ -404,6 +456,9 @@ def main(argv=None) -> int:
         rec = {"prompt": r["tokens"], "new": res.tokens,
                "status": res.status,
                "cached_prefix": res.cached_prefix_tokens}
+        if router is not None:
+            rec["replica"] = res.replica
+            rec["migrated"] = res.migrated
         if res.error is not None:
             rec["error"] = res.error
         if tok is not None:

@@ -193,12 +193,11 @@ class RadixCache:
         self._clock += 1
         return self._clock
 
-    def match(self, tokens) -> tuple[int, list[int]]:
-        """Longest cached prefix of ``tokens``: ``(m, blocks)`` with
-        ``blocks`` covering ``ceil(m / bt)``; ``(0, [])`` on a miss.
-        Refcounts are NOT acquired here — the caller attaches explicitly
-        (it may cap ``m`` further, e.g. to its own head length)."""
-        tokens = tuple(tokens)
+    def _walk(self, tokens: tuple) -> tuple["_Node", int]:
+        """Descend from the root along ``tokens``; returns the deepest
+        node reached and the matched prefix length. Pure traversal — no
+        LRU stamps, no refcounts — shared by :meth:`match` and
+        :meth:`longest_match_len`."""
         node, matched = self.root, 0
         while matched < len(tokens):
             child = node.children.get(tokens[matched])
@@ -214,6 +213,14 @@ class RadixCache:
                 node = child          # ended mid-edge: subtree extends us
                 break
             node = child
+        return node, matched
+
+    def match(self, tokens) -> tuple[int, list[int]]:
+        """Longest cached prefix of ``tokens``: ``(m, blocks)`` with
+        ``blocks`` covering ``ceil(m / bt)``; ``(0, [])`` on a miss.
+        Refcounts are NOT acquired here — the caller attaches explicitly
+        (it may cap ``m`` further, e.g. to its own head length)."""
+        node, matched = self._walk(tuple(tokens))
         entry = self._any_entry(node)
         if entry is None or matched == 0:
             return 0, []
@@ -222,6 +229,22 @@ class RadixCache:
             return 0, []
         entry.last_used = self._tick()
         return m, entry.blocks[:-(-m // self.bt)]
+
+    def longest_match_len(self, tokens) -> int:
+        """Affinity PROBE: the length :meth:`match` would return, with
+        ZERO side effects — no LRU touch, no refcount change, nothing
+        promoted or evicted. The replica router calls this on every
+        candidate replica per request (``serve_router``), so a probe
+        that mutated LRU order would let routing decisions evict state
+        the loser replicas still want; a probe must observe, never
+        vote. The returned length is a HINT: by admission time the
+        entry may have been evicted, and admission re-``match``es
+        authoritatively."""
+        node, matched = self._walk(tuple(tokens))
+        entry = self._any_entry(node)
+        if entry is None or matched == 0:
+            return 0
+        return min(matched, entry.n_tokens)
 
     def _any_entry(self, node: _Node) -> "_Entry | None":
         """Any entry in ``node``'s subtree — every path through ``node``

@@ -44,6 +44,7 @@ handle; per-test isolation is a configure/restore pair.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -53,6 +54,40 @@ import time
 from distributed_compute_pytorch_tpu.obs import metrics
 
 SCHEMA_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# replica tagging: the serve router runs each ContinuousBatcher replica on
+# its own worker thread, and every span/instant that replica emits fires in
+# that thread — so a thread-local tag attributes the whole existing event
+# stream (admit_wave/dispatch_segment/harvest/fault/...) to a replica with
+# zero new instrumentation at the call sites.
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+def set_replica(replica: int | None) -> int | None:
+    """Tag events recorded from THIS thread with ``replica``; returns
+    the previous tag so callers can restore (``None`` clears)."""
+    prev = getattr(_TLS, "replica", None)
+    _TLS.replica = replica
+    return prev
+
+
+def current_replica() -> int | None:
+    """The calling thread's replica tag, or None outside a replica."""
+    return getattr(_TLS, "replica", None)
+
+
+@contextlib.contextmanager
+def replica_tag(replica: int | None):
+    """Scope a replica tag over a block (the router wraps each worker
+    thread's ``serve_detailed`` call in one)."""
+    prev = set_replica(replica)
+    try:
+        yield
+    finally:
+        set_replica(prev)
 
 # default ring capacity: enough for several admission waves' worth of
 # serve events or a few hundred train steps at span granularity, at
@@ -91,6 +126,9 @@ class FlightRecorder:
         ev = {"kind": kind,
               "t_us": (time.perf_counter_ns() - self._epoch_ns) / 1e3,
               "tid": threading.get_native_id()}
+        rep = getattr(_TLS, "replica", None)
+        if rep is not None:
+            ev["replica"] = rep
         if fields:
             ev.update(fields)
         with self._mu:
@@ -134,6 +172,9 @@ class FlightRecorder:
                "recorded": len(events) + dropped,
                "dropped": dropped,
                "events": events}
+        rep = getattr(_TLS, "replica", None)
+        if rep is not None:
+            doc["replica"] = rep   # a replica thread's fault names itself
         if extra:
             doc.update(extra)
         target = path or self.path

@@ -558,6 +558,19 @@ class ContinuousBatcher:
             "mem": device_memory_gauges(self.obs, prefix="serve.mem."),
         }
 
+    def prefix_match_len(self, tokens) -> int:
+        """Affinity probe for the replica router: how many of
+        ``tokens``'s prompt-HEAD tokens this batcher's radix cache
+        holds (the length admission would attach). READ-ONLY —
+        ``RadixCache.longest_match_len`` touches no LRU stamp and no
+        refcount, so probing every replica per routing decision cannot
+        evict or promote anything. 0 with the prefix cache off. The
+        head excludes the last prompt token (never prefilled, never
+        cached — ``kv_pool`` module docstring)."""
+        if self._radix is None or len(tokens) < 2:
+            return 0
+        return self._radix.longest_match_len(list(tokens)[:-1])
+
     def profile_next(self, segments: int, profile_dir: str) -> None:
         """Arm ON-DEMAND XLA profiling: the next ``segments``
         dispatched decode segments run under ``jax.profiler`` traces

@@ -101,7 +101,14 @@ class RequestResult:
     first token, ``(latency_s - ttft_s) / (len(tokens) - 1)``
     (``None`` below 2 tokens). Every admitted request's values also
     land in the batcher's SLO histograms
-    (``ContinuousBatcher.stats_snapshot()["slo"]``)."""
+    (``ContinuousBatcher.stats_snapshot()["slo"]``).
+
+    Replica-set metadata (set by ``serve_router.ServeRouter``; inert
+    for direct single-batcher callers): ``migrated`` counts how many
+    times the request's session was replayed onto a DIFFERENT replica
+    after its original replica died (0 = never left its first
+    placement), and ``replica`` is the replica index that produced the
+    terminal result (``None`` outside the router)."""
 
     status: str = OK
     tokens: list = field(default_factory=list)
@@ -113,6 +120,8 @@ class RequestResult:
     queue_wait_s: float | None = None
     ttft_s: float | None = None
     tpot_s: float | None = None
+    migrated: int = 0
+    replica: int | None = None
 
     @property
     def ok(self) -> bool:

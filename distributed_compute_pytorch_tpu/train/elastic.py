@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -335,6 +336,61 @@ def call_with_timeout(fn: Callable[[], object], timeout: float,
     if "error" in box:
         raise box["error"]
     return box.get("value")
+
+
+def backoff_delays(budget: int, base_delay: float, jitter_seed: int = 0,
+                   *, factor: float = 2.0, jitter: float = 0.5
+                   ) -> list[float]:
+    """The deterministic exponential-backoff schedule shared by
+    :func:`retry_with_backoff` and the serve router's circuit breaker:
+    ``budget`` delays, the k-th being ``base_delay * factor**k``
+    stretched by up to ``jitter`` fraction of itself.
+
+    Jitter is drawn from ``random.Random(jitter_seed)`` — an EXPLICIT
+    seed, never ambient randomness — so two runs (or a test and the
+    code under test) can derive the identical schedule, and N replicas
+    seeded ``jitter_seed + i`` desynchronize their probe storms without
+    giving up reproducibility."""
+    if budget < 0:
+        raise ValueError(f"budget must be >= 0, got {budget}")
+    if base_delay < 0:
+        raise ValueError(f"base_delay must be >= 0, got {base_delay}")
+    rng = random.Random(jitter_seed)
+    return [base_delay * factor ** k * (1.0 + jitter * rng.random())
+            for k in range(budget)]
+
+
+def retry_with_backoff(fn: Callable[[], object], *, budget: int,
+                       base_delay: float, jitter_seed: int = 0,
+                       factor: float = 2.0, jitter: float = 0.5,
+                       retry_on: tuple = (Exception,),
+                       sleep: Callable[[float], None] = time.sleep,
+                       on_retry: Callable[[int, BaseException], None]
+                       | None = None):
+    """Call ``fn()``; on a ``retry_on`` exception, sleep the next
+    :func:`backoff_delays` delay and try again, up to ``budget``
+    retries (``budget + 1`` attempts total). Returns ``fn``'s value;
+    re-raises the last exception once the budget is spent.
+
+    The schedule is fully determined by ``(budget, base_delay,
+    jitter_seed, factor, jitter)``, so callers (the router's half-open
+    replica probes) and tests agree on exact timing. ``sleep`` is
+    injectable so tests assert the schedule without waiting it out;
+    ``on_retry(attempt, exc)`` observes each failure before the
+    sleep."""
+    delays = backoff_delays(budget, base_delay, jitter_seed,
+                            factor=factor, jitter=jitter)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt >= budget:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delays[attempt])
+            attempt += 1
 
 
 def supervise(child_argv: Sequence[str], *, max_restarts: int = 3,

@@ -25,7 +25,7 @@ from distributed_compute_pytorch_tpu.core.config import Config
 from distributed_compute_pytorch_tpu.data.datasets import synthetic_images
 from distributed_compute_pytorch_tpu.train.elastic import (
     EXIT_PREEMPTED, CallTimeout, Heartbeat, PreemptionGuard,
-    call_with_timeout, supervise)
+    backoff_delays, call_with_timeout, retry_with_backoff, supervise)
 from distributed_compute_pytorch_tpu.train.trainer import Trainer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -71,6 +71,67 @@ def test_preemption_guard_second_signal_respects_sig_ign():
             assert signal.getsignal(signal.SIGUSR1) is signal.SIG_IGN
     finally:
         signal.signal(signal.SIGUSR1, prev)
+
+
+def test_backoff_delays_deterministic_schedule():
+    """The schedule is a pure function of its arguments: same seed ->
+    same jittered delays (the router's half-open probes depend on this
+    for reproducible drills), different seed -> different jitter, and
+    every delay sits inside [base*2^k, base*2^k*(1+jitter)]."""
+    a = backoff_delays(4, 0.25, jitter_seed=7)
+    assert a == backoff_delays(4, 0.25, jitter_seed=7)
+    assert a != backoff_delays(4, 0.25, jitter_seed=8)
+    for k, d in enumerate(a):
+        lo = 0.25 * 2.0 ** k
+        assert lo <= d <= lo * 1.5
+    assert backoff_delays(0, 0.25) == []
+    with pytest.raises(ValueError):
+        backoff_delays(-1, 0.25)
+    with pytest.raises(ValueError):
+        backoff_delays(2, -0.1)
+
+
+def test_retry_with_backoff_succeeds_sleeping_the_schedule():
+    """budget=N means N retries (N+1 attempts); the sleeps observed en
+    route are exactly the backoff_delays prefix, and on_retry sees each
+    failure before its sleep."""
+    slept, seen = [], []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(f"down {calls['n']}")
+        return "up"
+
+    out = retry_with_backoff(
+        flaky, budget=4, base_delay=0.25, jitter_seed=7,
+        sleep=slept.append,
+        on_retry=lambda attempt, exc: seen.append((attempt, str(exc))))
+    assert out == "up" and calls["n"] == 3
+    assert slept == backoff_delays(4, 0.25, jitter_seed=7)[:2]
+    assert seen == [(0, "down 1"), (1, "down 2")]
+
+
+def test_retry_with_backoff_exhausts_and_reraises_last():
+    slept = []
+    with pytest.raises(OSError, match="attempt 2"):
+        retry_with_backoff(
+            lambda: (_ for _ in ()).throw(OSError(f"attempt {len(slept)}")),
+            budget=2, base_delay=0.5, jitter_seed=3, sleep=slept.append)
+    assert slept == backoff_delays(2, 0.5, jitter_seed=3)
+
+
+def test_retry_with_backoff_retry_on_filters():
+    """Exceptions outside retry_on escape immediately — no sleeps,
+    no further attempts."""
+    slept = []
+    with pytest.raises(KeyError):
+        retry_with_backoff(
+            lambda: (_ for _ in ()).throw(KeyError("fatal")),
+            budget=3, base_delay=0.1, retry_on=(OSError,),
+            sleep=slept.append)
+    assert slept == []
 
 
 def test_preemption_guard_latches_signal():

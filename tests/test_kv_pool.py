@@ -120,6 +120,52 @@ def test_radix_eviction_lru_and_live_blocks_survive():
     assert pool.leak_check({}) == 0
 
 
+def test_radix_longest_match_len_agrees_with_match():
+    """The router's affinity probe must report exactly what match()
+    would attach — for exact hits, mid-edge prefixes, divergent
+    branches and misses."""
+    pool, tree = _pool_and_tree(bt=4)
+    seq_a = list(range(10))
+    tree.insert(seq_a, pool.alloc(3))
+    seq_b = seq_a[:6] + [50, 51, 52]
+    tree.insert(seq_b, pool.alloc(3))
+    for q in (seq_a, seq_a[:6] + [99, 98], seq_b, [77, 78], seq_a[:3],
+              seq_a + [1, 2, 3]):
+        assert tree.longest_match_len(q) == tree.match(q)[0], q
+
+
+def test_radix_probe_never_mutates():
+    """Pinning the non-mutating contract: probing touches no LRU stamp
+    and no refcount, so a storm of routing probes can neither promote
+    an entry out of eviction order nor evict anything."""
+    pool, tree = _pool_and_tree(bt=4, blocks=8)   # 7 usable
+    a = pool.alloc(2)
+    tree.insert(list(range(8)), a)
+    b = pool.alloc(2)
+    tree.insert([9, 9] + list(range(6)), b)
+    pool.release(a)
+    pool.release(b)                     # rows done; tree-only refs
+    tree.match([9, 9])                  # refresh b: a becomes LRU
+    refs_before = list(pool.ref)
+    stamps_before = [(e.n_tokens, e.last_used) for e in tree.entries]
+    clock_before = tree._clock
+    free_before = pool.free_count
+    for _ in range(100):                # a probe storm
+        assert tree.longest_match_len(list(range(8))) == 8
+        assert tree.longest_match_len([9, 9, 0, 1]) == 4
+        assert tree.longest_match_len([77]) == 0
+    assert pool.ref == refs_before
+    assert [(e.n_tokens, e.last_used) for e in tree.entries] \
+        == stamps_before
+    assert tree._clock == clock_before
+    assert pool.free_count == free_before
+    # and eviction order is unchanged by all that probing: a (the LRU
+    # entry, despite being the probe target) still evicts first
+    tree.evict_for(free_before + 1)
+    assert tree.match(list(range(8)))[0] == 0     # a gone
+    assert tree.match([9, 9])[0] > 0              # b survives
+
+
 # ---------------------------------------------- paged pool write parity
 
 

@@ -1,0 +1,348 @@
+"""Replica-set serving (serve_router.py): the chaos drills for ISSUE 11.
+
+The failure domain is one replica of N. These drills pin the router's
+whole contract on a shared 3-replica tiny-GPT2 fleet (one compile, many
+sessions — ROADMAP budget note): batch parity with a single unloaded
+replica (greedy AND sampled, explicit and index-default seeds),
+prefix-affinity dispatch to the warm replica, the flagship
+kill-one-replica-mid-stream migration (token-identical outputs, zero
+slot/block leaks on the survivors, flight dump naming the dead replica
+and the migrated sessions), breaker/probe lifecycle, deadline-aware
+re-shedding at failover, zero-healthy fail-fast, cluster-wide drain,
+and the heartbeat-staleness takeover. The open-loop Poisson drill rides
+behind ``slow``.
+"""
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.obs import flight
+from distributed_compute_pytorch_tpu.obs.loadgen import LoadSpec, offered_load
+from distributed_compute_pytorch_tpu.serve import ContinuousBatcher, Request
+from distributed_compute_pytorch_tpu.serve_lifecycle import (
+    CANCELLED, FAILED, OK, SHED, TIMEOUT, ChaosInjector)
+from distributed_compute_pytorch_tpu.serve_router import (
+    CLOSED, DEAD, HALF_OPEN, OPEN, CircuitBreaker, ServeRouter, _Session)
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Three independent replicas sharing one set of params. Same
+    shapes -> the in-process executable cache makes replicas 2 and 3
+    nearly free; per-test ``reset()`` gives each drill a fresh session
+    on warm programs."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    return [ContinuousBatcher(model, params, slots=2, t_max=64,
+                              prompt_buf=12, segment=3, prefix_cache=True,
+                              max_recoveries=0)
+            for _ in range(3)]
+
+
+def _reset(fleet):
+    for r in fleet:
+        r.reset()
+
+
+def _requests(rng, n, lo=2, hi=10, min_new=5, max_new=9):
+    reqs = []
+    for _ in range(n):
+        ln = int(rng.integers(lo, hi))
+        reqs.append(Request(
+            tokens=[int(t) for t in rng.integers(0, 256, size=ln)],
+            max_new=int(rng.integers(min_new, max_new + 1))))
+    return reqs
+
+
+def _mixed_batch(seed=7, n=8):
+    """Greedy + sampled with an explicit seed + sampled with the
+    index-default seed — placement must be invisible to all three."""
+    reqs = _requests(np.random.default_rng(seed), n)
+    reqs[1].temperature = 0.8
+    reqs[1].seed = 123
+    reqs[3].temperature = 0.9          # seed=None -> request-index default
+    return reqs
+
+
+def _copies(reqs):
+    return [dataclasses.replace(r) for r in reqs]
+
+
+def _assert_no_leaks(fleet):
+    for i, rep in enumerate(fleet):
+        assert rep.last_slot_leaks == 0, i
+        assert rep.last_block_leaks == 0, i
+
+
+# ------------------------------------------------------------- breaker unit
+
+
+def test_circuit_breaker_state_machine():
+    b = CircuitBreaker(fault_threshold=2, probe_budget=2,
+                       probe_base_delay_s=0.25, jitter_seed=5)
+    assert b.state == CLOSED and b.healthy
+    b.record_fault(now=100.0)
+    assert b.state == CLOSED            # 1 of 2 consecutive
+    b.record_ok()
+    b.record_fault(now=100.0)
+    assert b.state == CLOSED            # ok reset the streak
+    b.record_fault(now=100.0)
+    assert b.state == OPEN and b.trips == 1
+    # retry time follows the deterministic schedule, not a fresh draw
+    from distributed_compute_pytorch_tpu.train.elastic import backoff_delays
+    delays = backoff_delays(2, 0.25, jitter_seed=5)
+    assert b.retry_at == 100.0 + delays[0]
+    assert not b.probe_due(100.0 + delays[0] / 2)
+    assert b.probe_due(100.0 + delays[0])
+    b.begin_probe()
+    assert b.state == HALF_OPEN
+    b.record_fault(now=200.0)           # failed probe: next (longer) delay
+    assert b.state == OPEN and b.retry_at == 200.0 + delays[1]
+    b.begin_probe()
+    b.record_fault(now=300.0)           # schedule exhausted
+    assert b.state == DEAD and b.retry_at is None
+    assert not b.probe_due(1e9)         # auto-probing never revives DEAD
+    b.record_ok()                       # only an explicit probe success does
+    assert b.state == CLOSED and b.consecutive == 0
+
+
+# -------------------------------------------------------- parity + dispatch
+
+
+def test_router_parity_with_single_replica(fleet):
+    """3 replicas must be an invisible implementation detail: every
+    stream token-identical to one unloaded batcher, work actually
+    spread over the fleet."""
+    _reset(fleet)
+    reqs = _mixed_batch()
+    ref = fleet[0].serve_detailed(_copies(reqs))
+    assert all(r.ok for r in ref)
+    _reset(fleet)
+    router = ServeRouter(fleet, jitter_seed=42)
+    res = router.route(_copies(reqs))
+    assert all(r.ok for r in res), [r.error for r in res]
+    assert [r.tokens for r in res] == [r.tokens for r in ref]
+    assert all(r.migrated == 0 and r.replica is not None for r in res)
+    assert sum(router.routed_per_replica) == len(reqs)
+    assert all(c > 0 for c in router.routed_per_replica)
+    assert router.stats["routed"] == len(reqs)
+    assert router.stats["failovers"] == 0
+    _assert_no_leaks(fleet)
+    snap = router.stats_snapshot()
+    assert [r["breaker"] for r in snap["replicas"]] == [CLOSED] * 3
+
+
+def test_affinity_routes_to_warm_replica(fleet):
+    """A replica holding the longest cached prefix wins the request;
+    the read-only probe itself never warms the cold replicas."""
+    _reset(fleet)
+    warm = list(range(40, 52))                       # 12-token prompt
+    ok = fleet[0].serve_detailed([Request(tokens=warm, max_new=3)])
+    assert ok[0].ok                                  # head warm[:11] cached
+    router = ServeRouter(fleet, jitter_seed=1, affinity_min_tokens=4)
+    reqs = [Request(tokens=warm[:11] + [200 + k], max_new=4)
+            for k in range(4)]
+    res = router.route(reqs)
+    assert all(r.ok for r in res)
+    assert router.routed_per_replica == [4, 0, 0]
+    assert router.stats["affinity_routed"] == 4
+    assert all(r.replica == 0 for r in res)
+    # probing replicas 1/2 every decision cached nothing there
+    assert fleet[1].prefix_match_len(warm) == 0
+    assert fleet[2].prefix_match_len(warm) == 0
+    # and the warm replica actually skipped prefill work
+    assert all(r.cached_prefix_tokens > 0 for r in res)
+
+
+# ------------------------------------------------------- flagship kill drill
+
+
+def test_kill_one_replica_mid_stream_migrates_token_identical(fleet):
+    """ISSUE 11 acceptance drill: 3 replicas, one killed mid-stream.
+    Every non-shed request finishes token-identical to the unloaded
+    single-replica reference (greedy and sampled), survivors leak
+    nothing, and the flight dump names the dead replica and the
+    migrated sessions."""
+    _reset(fleet)
+    reqs = _mixed_batch()
+    ref = fleet[0].serve_detailed(_copies(reqs))
+    _reset(fleet)
+    rec = flight.FlightRecorder(capacity=512)
+    prev = flight.configure_flight(rec)
+    try:
+        router = ServeRouter(fleet, jitter_seed=42)
+        chaos = {1: ChaosInjector(fault_at_segment=2, fault_mode="raise")}
+        res = router.route(_copies(reqs), chaos=chaos)
+    finally:
+        flight.configure_flight(prev)
+    assert all(r.ok for r in res), [r.error for r in res]
+    assert [r.tokens for r in res] == [r.tokens for r in ref]
+    migrated = [r for r in res if r.migrated]
+    assert migrated and router.stats["migrations"] >= len(migrated)
+    assert all(r.replica in (0, 2) for r in migrated)   # finished elsewhere
+    assert router.stats["failovers"] >= 1
+    _assert_no_leaks(fleet)
+    # flight artifact: the failover dump names the dead replica and the
+    # migrated sessions, with replica-tagged events in the ring
+    d = rec.last_dump
+    assert d is not None and d["reason"] == "replica_failover"
+    assert d["replica"] == 1 and d["migrated"]
+    assert any(ev.get("replica") == 1 for ev in d["events"])
+    # the dead replica's breaker opened; an operator probe (the chaos
+    # injector is spent, the canary succeeds) re-closes it
+    assert router.breaker_states()[1] in (OPEN, HALF_OPEN)
+    slept = []
+    router._sleep = slept.append        # don't wait the schedule out
+    assert router.probe_replica(1)
+    assert router.breaker_states()[1] == CLOSED
+    assert router.stats["probe_successes"] >= 1
+    # the revived replica takes traffic again
+    res2 = router.route([Request(tokens=[3, 4, 5], max_new=3)
+                         for _ in range(3)])
+    assert all(r.ok for r in res2)
+    assert router.routed_per_replica[1] > 0
+
+
+# --------------------------------------------------- degradation + shedding
+
+
+def test_all_replicas_dead_fails_fast_with_partials(fleet):
+    """Zero healthy replicas must fail fast with a structured error —
+    and the partial streams the dead replicas reported are preserved
+    in the failed results, not dropped."""
+    _reset(fleet)
+    router = ServeRouter([fleet[1], fleet[2]], jitter_seed=3,
+                         probe_base_delay_s=30.0)   # no probe mid-test
+    # fault at segment 3: with overlapped dispatch the k-th harvest
+    # runs with k+1 segments already dispatched, so segment 1's tokens
+    # land before the second harvest trips
+    chaos = {0: ChaosInjector(fault_at_segment=3, fault_mode="raise"),
+             1: ChaosInjector(fault_at_segment=3, fault_mode="raise")}
+    reqs = [Request(tokens=[9, 8, 7], max_new=9),
+            Request(tokens=[1, 2, 3, 4], max_new=9)]
+    res = router.route(reqs, chaos=chaos)
+    assert [r.status for r in res] == [FAILED, FAILED]
+    assert all("no healthy replica (0 of 2 closed)" in r.error for r in res)
+    # both replicas harvested one full segment before dying: those
+    # partial streams survive the double failover into the results
+    assert all(len(r.tokens) > 0 for r in res)
+    assert all(r.migrated >= 1 for r in res)
+    assert router.stats["unplaceable"] == 2
+    assert router.breaker_states() == [OPEN, OPEN]
+    _assert_no_leaks(fleet)
+
+
+def test_failover_deadline_shed_unit(fleet):
+    """At failover, a migrated-candidate already past its deadline is
+    re-shed instead of burning survivor capacity (the status mapping —
+    timeout with partials, shed when nothing ran — lives in ``route``'s
+    shed closure; this pins the branch selection)."""
+    router = ServeRouter(fleet, jitter_seed=0)
+    now = time.monotonic()
+    mk = lambda **kw: _Session(req=Request(tokens=[1, 2], max_new=4),
+                               arrive_abs=now, **kw)
+    sessions = [mk(deadline_at=now + 60.0),         # in budget: migrates
+                mk(deadline_at=now - 1.0),          # expired, has partial
+                mk(deadline_at=now - 1.0)]          # expired, never ran
+    sessions[1].tokens = [5]
+    shed, next_pending = [], []
+    router._fail_over(1, [0, 1, 2], [], sessions, "drill", now, 0.0,
+                      lambda j, why, t, drain_cut=False:
+                      shed.append((j, why)), next_pending)
+    assert next_pending == [0] and sessions[0].migrated == 1
+    assert [j for j, _ in shed] == [1, 2]
+    assert all("deadline expired during failover of replica 1" in why
+               for _, why in shed)
+    assert sessions[1].migrated == 0 and sessions[2].migrated == 0
+    assert router.stats["failover_sheds"] == 2
+    assert router.stats["migrations"] == 1
+    assert router.breaker_states()[1] == OPEN
+
+
+def test_cluster_drain(fleet):
+    """One SIGTERM drains the whole replica set: work shed by a
+    draining replica is never re-placed, and a drain observed between
+    rounds sheds everything still pending at the router."""
+
+    class _Guard:
+        preempted = False
+
+    _reset(fleet)
+    # drain latched before routing: nothing runs at all
+    pre = _Guard()
+    pre.preempted = True
+    router = ServeRouter(fleet, jitter_seed=2)
+    res = router.route(_requests(np.random.default_rng(0), 4), drain=pre)
+    assert [r.status for r in res] == [SHED] * 4
+    assert all("cluster drain" in r.error for r in res)
+    assert router.stats["rounds"] == 0
+
+    # drain flipped mid-stream on one replica's segment hook: every
+    # replica sees the same latch, finishes in-flight rows and sheds
+    # its queue; the router re-places none of it
+    guard = _Guard()
+
+    def flip(_seg):
+        guard.preempted = True
+
+    chaos = {i: ChaosInjector(on_segment=flip) for i in range(3)}
+    router2 = ServeRouter(fleet, jitter_seed=2)
+    res2 = router2.route(_requests(np.random.default_rng(1), 9),
+                         drain=guard, chaos=chaos)
+    assert {r.status for r in res2} <= {OK, SHED, CANCELLED, TIMEOUT}
+    assert router2.stats["migrations"] == 0
+    assert router2.stats["failovers"] == 0
+    _assert_no_leaks(fleet)
+
+
+def test_heartbeat_stale_takeover(fleet):
+    """A replica wedged hard enough that its scheduler thread stops
+    beating (bounded in-fetch hang, no tick watchdog) is declared dead
+    mid-round; its assignment replays on the survivors token-identical
+    and the zombie's eventual output is discarded."""
+    _reset(fleet)
+    reqs = _requests(np.random.default_rng(11), 6, min_new=6, max_new=9)
+    ref = fleet[0].serve_detailed(_copies(reqs))
+    _reset(fleet)
+    router = ServeRouter(fleet, jitter_seed=9, heartbeat_stale_s=0.6)
+    chaos = {2: ChaosInjector(fault_at_segment=1, fault_mode="hang",
+                              hang_s=2.5)}
+    res = router.route(_copies(reqs), chaos=chaos)
+    assert router.stats["takeovers"] >= 1
+    assert all(r.ok for r in res), [r.error for r in res]
+    assert [r.tokens for r in res] == [r.tokens for r in ref]
+    assert any(r.migrated and r.replica in (0, 1) for r in res)
+    assert router.breaker_states()[2] in (OPEN, HALF_OPEN)
+    # let the zombie finish before anyone resets the hung replica
+    router.join_stragglers(timeout=10.0)
+    assert not router._busy[2]
+    _reset(fleet)
+
+
+# ----------------------------------------------------- open-loop full drill
+
+
+@pytest.mark.slow
+def test_router_poisson_drill_with_kill(fleet):
+    """Full open-loop drill: Poisson arrivals over 3 replicas with one
+    replica killed mid-stream — every completed stream token-identical
+    to the unloaded single-replica serve of the same offered load."""
+    _reset(fleet)
+    spec = LoadSpec(n_requests=24, rate_rps=40.0, seed=5,
+                    prompt_len=(2, 10), max_new=(4, 10))
+    reqs = offered_load(spec)
+    ref = fleet[0].serve_detailed(_copies(reqs))
+    _reset(fleet)
+    router = ServeRouter(fleet, jitter_seed=21)
+    chaos = {1: ChaosInjector(fault_at_segment=3, fault_mode="raise")}
+    res = router.route(_copies(reqs), chaos=chaos)
+    assert all(r.ok for r in res), [r.error for r in res]
+    assert [r.tokens for r in res] == [r.tokens for r in ref]
+    assert router.stats["failovers"] >= 1
+    assert router.stats["migrations"] >= 1
+    _assert_no_leaks(fleet)
