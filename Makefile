@@ -25,7 +25,7 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
-	serve-load-smoke serve-router-smoke bench-diff
+	serve-spec-smoke serve-load-smoke serve-router-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -60,6 +60,13 @@ bench:
 #   prefill_tokens_saved > 0, COW runs, no block/slot leaks, and the
 #   warm-cache admission TTFT proxy is not degraded; records
 #   prefill-bytes-saved
+# - serve-spec: speculative decoding on a repetitive stream (the
+#   n-gram self-drafting best case with random rejects mixed in);
+#   fails unless spec-on output is token-identical to spec-off (the
+#   accept rule is exact), the acceptance rate is positive, useful
+#   tokens per verify window exceed 1 (each window costs one weight
+#   stream — the >1.5x hardware-target mechanism), auto-disable never
+#   trips, and no block/slot leaks; records walls with spread
 # - serve-load: the open-loop Poisson load drill over the telemetry
 #   subsystem (obs/); fails unless goodput > 0 with finite p99 TTFT,
 #   tokens are identical to the unloaded path, no slot/block leaks,
@@ -81,6 +88,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --grad-accum-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-spec-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
 	$(MAKE) bench-diff
@@ -103,6 +111,9 @@ serve-chaos-smoke:
 
 serve-prefix-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
+
+serve-spec-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-spec-smoke
 
 serve-load-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke

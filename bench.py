@@ -1738,6 +1738,111 @@ def serve_prefix_smoke():
     return 0
 
 
+def serve_spec_smoke():
+    """CPU-sized end-to-end check of speculative decoding
+    (`make serve-spec-smoke`, wired into `make bench-smoke`): tiny
+    GPT-2 serving a REPETITIVE stream — looped token periods, the
+    self-drafting n-gram proposer's best case — with ``speculate`` ON
+    vs OFF on the same paged-pool engine.
+
+    Asserts the acceptance contract: served tokens TOKEN-IDENTICAL to
+    spec-off (the accept/reject rule is exact — this is the whole
+    bargain), acceptance_rate > 0 on the repetitive stream, USEFUL
+    tokens per verify window > 1 (each window costs one weight stream,
+    like one plain tick, so >1 emitted/window is the throughput win
+    mechanism), and zero slot/block leaks after drain. Records the
+    stream walls with their best-of-3 spread for `bench-diff`. Wall
+    SPEEDUP is deliberately not asserted here: a tiny CPU model is
+    latency- not HBM-bound, so the verify window's arithmetic isn't
+    free the way it is on hardware — the >1.5x useful-tok/s target on
+    ``serve_long_stream`` (ISSUE 12) is a TPU bench number; this smoke
+    pins the mechanism (emitted/window) that produces it."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    from distributed_compute_pytorch_tpu.models.gpt2 import (
+        GPT2, GPT2Config)
+    from distributed_compute_pytorch_tpu.serve import (
+        ContinuousBatcher, Request)
+    from distributed_compute_pytorch_tpu.spec_decode import SpecConfig
+
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=256))
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    # repetitive stream: looped periods (code/JSON-shaped decodes) plus
+    # a few random prompts so the reject path runs in the same walls
+    reqs = []
+    for i in range(12):
+        if i % 4 == 3:
+            head = [int(t) for t in rng.integers(0, 256, 8)]
+        else:
+            period = [int(t) for t in rng.integers(0, 256, 3)]
+            head = period * 4
+        reqs.append(Request(head, 16))
+
+    def clone(rs):
+        return [dataclasses.replace(r) for r in rs]
+
+    kw = dict(slots=4, t_max=64, prompt_buf=16, segment=4)
+    off = ContinuousBatcher(model, params, **kw)
+    on = ContinuousBatcher(model, params,
+                           speculate=SpecConfig(k=4), **kw)
+    off.serve(clone(reqs))        # warm every compile out of the walls
+    on.serve(clone(reqs))
+
+    def best_wall(cb, k=3):
+        walls, outs = [], None
+        for _ in range(k):
+            cb.reset()
+            t0 = time.perf_counter()
+            outs = cb.serve(clone(reqs))
+            walls.append(time.perf_counter() - t0)
+        best = min(walls)
+        spread = round((max(walls) - best) / best, 4) if best > 0 else 0.0
+        return best, spread, outs
+
+    wall_off, spread_off, out_off = best_wall(off)
+    wall_on, spread_on, out_on = best_wall(on)
+    s = dict(on.spec)
+    row_verifies = s["proposed"] / 4            # k drafts per window
+    tok_per_window = (s["emitted_tokens"] / row_verifies
+                      if row_verifies else 0.0)
+    leaks = (on.last_block_leaks, on.last_slot_leaks,
+             off.last_block_leaks, off.last_slot_leaks)
+    checks = {
+        "token_parity_vs_spec_off": out_on == out_off,
+        "acceptance_rate_positive": s["acceptance_rate"] > 0,
+        "useful_tokens_per_window_gt_1": tok_per_window > 1.0,
+        "zero_leaks": leaks == (0, 0, 0, 0),
+        "never_autodisabled": s["autodisabled"] == 0,
+    }
+    _print_record({
+        "metric": "serve_spec_smoke",
+        "requests": len(reqs),
+        "speculate_k": 4,
+        "proposed": s["proposed"],
+        "accepted": s["accepted"],
+        "acceptance_rate": round(s["acceptance_rate"], 4),
+        "wasted_verify_tokens": s["wasted_verify_tokens"],
+        "verify_segments": s["verify_segments"],
+        "emitted_tokens": s["emitted_tokens"],
+        "useful_tokens_per_window": round(tok_per_window, 3),
+        "stream_wall_s": {"spec_off": round(wall_off, 4),
+                          "spec_on": round(wall_on, 4)},
+        "spread": max(spread_off, spread_on),
+        "target": ("useful tok/s > 1.5x spec-off on serve_long_stream "
+                   "(TPU hardware bench; see DESIGN.md)"),
+        "snapshot": on.stats_snapshot(),
+        "checks": checks})
+    bad = [k for k, ok in checks.items() if not ok]
+    if bad:
+        raise SystemExit(f"serve spec smoke failed: {bad}")
+    return 0
+
+
 def serve_load_smoke():
     """Open-loop Poisson load drill for the telemetry subsystem
     (`make serve-load-smoke`, wired into `make bench-smoke`): tiny
@@ -2004,6 +2109,8 @@ def main():
         return serve_chaos_smoke()
     if "--serve-prefix-smoke" in sys.argv:
         return serve_prefix_smoke()
+    if "--serve-spec-smoke" in sys.argv:
+        return serve_spec_smoke()
     if "--serve-load-smoke" in sys.argv:
         return serve_load_smoke()
     if "--serve-router-smoke" in sys.argv:

@@ -188,6 +188,16 @@ def main(argv=None) -> int:
                         "line reports its 'cached_prefix' length. Not "
                         "supported for --model moe (routing is "
                         "group-dependent)")
+    p.add_argument("--speculate", type=int, default=0,
+                   help="speculative decoding: draft K tokens per "
+                        "verify step with the self-drafting n-gram "
+                        "proposer and score all K+1 positions in one "
+                        "forward pass — outputs stay token-identical "
+                        "(the accept/reject rule is exact; greedy AND "
+                        "sampled), only throughput moves. Replicas "
+                        "inherit the setting. Sustained low acceptance "
+                        "auto-disables back to plain decode. 0 (default) "
+                        "= off; not supported for --model moe")
     p.add_argument("--kv_block_tokens", type=int, default=None,
                    help="logical tokens per KV-pool block (default: "
                         "the Pallas cache window; rounded up to a "
@@ -380,7 +390,8 @@ def main(argv=None) -> int:
             kv_block_tokens=args.kv_block_tokens,
             prefix_cache=args.prefix_cache,
             heartbeat_s=args.heartbeat or None,
-            on_heartbeat=hb_cb)
+            on_heartbeat=hb_cb,
+            speculate=args.speculate or None)
 
     router = None
     if args.replicas > 1:

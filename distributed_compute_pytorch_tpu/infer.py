@@ -238,6 +238,25 @@ def sample_rows(logits, temperature, top_k, top_p, keys):
     return jnp.where(temperature == 0.0, greedy, sampled)
 
 
+def verify_sample_rows(logits, temperature, top_k, top_p, keys):
+    """:func:`sample_rows` over a verify WINDOW: ``logits [B, W, vocab]``
+    and ``keys [B, W]`` -> ``[B, W]`` tokens, one :func:`sample_rows`
+    call per window position (a Python loop — W is small and static).
+
+    Position ``i`` draws with key ``keys[:, i]``, which the serving loop
+    builds from the SAME (seed, tokens-generated) fold-in schedule plain
+    decode uses at that logical position — so column ``i`` here is
+    bit-identical to the token plain decode would sample after emitting
+    ``i`` window tokens. That identity is the whole exactness argument
+    for speculative accept/reject: the verify output at the first draft
+    mismatch IS the deterministic rejection resample.
+    """
+    cols = [sample_rows(logits[:, i], temperature, top_k, top_p,
+                        keys[:, i])
+            for i in range(logits.shape[1])]
+    return jnp.stack(cols, axis=1)
+
+
 def make_generate_fn(model, max_new_tokens: int, *, t_max: int | None = None,
                      temperature: float = 0.0, eos_id: int | None = None,
                      top_k: int | None = None, top_p: float | None = None,

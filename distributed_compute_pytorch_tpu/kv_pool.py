@@ -38,7 +38,13 @@ params, same tokens, same positions), and attaching is exact, not
 approximate. Blocks reachable from the tree are immutable over their
 recorded valid span: the owning row only ever appends at slots >= its
 own prefill extent, which later matchers never read (a match length is
-capped by the entry's recorded token count).
+capped by the entry's recorded token count). Speculative decoding makes
+the append-only invariant LOCALLY enforced rather than argued: before a
+verify window may write, any refcount>1 block under the window's slot
+span is copy-on-write'd (:meth:`BlockPool.shared` is the test), so even
+a rejected draft's garbage writes land only in blocks the row owns
+exclusively — a radix-attached prefix block is never mutated, provably,
+whatever the scheduler above does.
 """
 
 from __future__ import annotations
@@ -100,6 +106,16 @@ class BlockPool:
         tree insertion)."""
         assert self.ref[block] > 0, f"acquire on dead block {block}"
         self.ref[block] += 1
+
+    def shared(self, block: int) -> bool:
+        """True when ``block`` has more than one live reference — i.e.
+        some OTHER owner (a radix entry, an attached row) also reads it.
+        The serve scheduler's write-side guard: before a speculative
+        verify window may write into a block's span, a shared block is
+        copy-on-write'd so rejected drafts provably never mutate a
+        radix-attached prefix (``serve.ContinuousBatcher``,
+        ``cow_for_write``)."""
+        return self.ref[block] > 1
 
     def release(self, blocks) -> None:
         """Drop one reference per block; refcount-0 blocks return to the

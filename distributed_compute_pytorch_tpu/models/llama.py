@@ -236,6 +236,27 @@ class LlamaBlock:
                                                  A.merge_heads(o))
         return self._mlp(params, x), cache
 
+    def verify_step(self, params, x, cache, positions, slot_mask=None):
+        """One speculative VERIFY step: ``x [B, W, d]`` scores a whole
+        draft window at per-query ``positions [B, W]`` against the PAGED
+        cache in one pass. Window queries/keys rope at their OWN absolute
+        slots (``apply_rope`` broadcasts ``[B, W]`` positions), so
+        position differences — all RoPE sees — match ``W`` sequential
+        :meth:`decode_step` ticks exactly; the staircase attention mask
+        (``ops/attention.py::cache_verify_and_attend``) supplies the same
+        slots-at-or-before-query visibility. GQA folds the group dim into
+        the window dim on the read, keeping the cache at kv-head width."""
+        c = self.config
+        d, hd = c.d_model, c.head_dim
+        dense = lambda din, dout: L.Dense(din, dout, use_bias=False)
+        h = L.RMSNorm(d, c.rms_eps).apply(params["attn_norm"], x)
+        q, k, v = self._qkv(params, h, positions)
+        o, cache = A.cache_verify_and_attend(q, k, v, cache, positions,
+                                             slot_mask=slot_mask)
+        x = x + dense(c.num_heads * hd, d).apply(params["o"],
+                                                 A.merge_heads(o))
+        return self._mlp(params, x), cache
+
 
 @dataclass(frozen=True)
 class LlamaLM:

@@ -163,6 +163,27 @@ def attention_decode_tick(params, x, cache, pos, *, num_heads: int,
             cache)
 
 
+def attention_verify_tick(params, x, cache, positions, *, num_heads: int,
+                          slot_mask=None):
+    """The shared attention half of one speculative VERIFY step: like
+    :func:`attention_decode_tick` but over a ``W``-token draft window —
+    ``x [B, W, d]`` at per-query ``positions [B, W]``, one fused QKV for
+    the whole window, one paged-pool scatter + staircase-masked attention
+    (``ops/attention.py::cache_verify_and_attend``). Returns
+    ``(x + attn_residual, new_cache)``."""
+    d = x.shape[-1]
+    h = L.LayerNorm(d).apply(params["ln1"], x)
+    qkv = L.Dense(d, 3 * d).apply(params["qkv"], h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = A.split_heads(q, num_heads)
+    k = A.split_heads(k, num_heads)
+    v = A.split_heads(v, num_heads)
+    o, cache = A.cache_verify_and_attend(q, k, v, cache, positions,
+                                         slot_mask=slot_mask)
+    return (x + L.Dense(d, d).apply(params["attn_out"], A.merge_heads(o)),
+            cache)
+
+
 @dataclass(frozen=True)
 class TransformerBlock:
     """Pre/post-LN transformer block with fused-QKV MHA and GELU MLP."""
@@ -265,6 +286,22 @@ class TransformerBlock:
         assert self.causal and self.pre_ln, "decode needs a causal pre-LN block"
         d = self.d_model
         x, cache = attention_decode_tick(params, x, cache, pos,
+                                         num_heads=self.num_heads,
+                                         slot_mask=slot_mask)
+        h = L.LayerNorm(d).apply(params["ln2"], x)
+        return x + self._mlp(params, h, None, False), cache
+
+    def verify_step(self, params, x, cache, positions, slot_mask=None):
+        """One speculative VERIFY step: ``x [B, W, d]`` scores a whole
+        draft window at per-query ``positions [B, W]`` (consecutive
+        per-row slots) against the PAGED cache in one forward pass.
+        Position ``w``'s output depends only on cache slots ``<=
+        positions[b, w]`` — identical semantics to ``W`` sequential
+        :meth:`decode_step` ticks, which is what the exact accept/reject
+        rule relies on (``serve.ContinuousBatcher``)."""
+        assert self.causal and self.pre_ln, "verify needs a causal pre-LN block"
+        d = self.d_model
+        x, cache = attention_verify_tick(params, x, cache, positions,
                                          num_heads=self.num_heads,
                                          slot_mask=slot_mask)
         h = L.LayerNorm(d).apply(params["ln2"], x)

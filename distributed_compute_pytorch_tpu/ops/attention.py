@@ -127,6 +127,19 @@ def _pos_valid_mask(pos, t_max: int):
     return (slots <= pos)[None, None, None, :]
 
 
+def _multi_pos_valid_mask(pos, t_max: int):
+    """``[B, 1, W, T]`` bool mask for a verify WINDOW of queries: query
+    ``w`` of row ``b`` sits at position ``pos[b, w]`` and may attend cache
+    slots at-or-before it — the per-query generalisation of
+    :func:`_pos_valid_mask` (which this reduces to at ``W == 1``). This
+    is exactly the bottom-right-causal shape speculative verify needs:
+    window queries are consecutive positions, so the staircase mask IS
+    the causal rule over (prefix + window)."""
+    pos = jnp.asarray(pos)
+    slots = jnp.arange(t_max)
+    return slots[None, None, None, :] <= pos[:, None, :, None]
+
+
 def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
                      slot_mask=None):
     """Single-position decode attention over a preallocated K/V cache.
@@ -139,8 +152,10 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
         cache itself stays at kv-head width (the whole point of GQA:
         cache memory and bandwidth scale with ``Hk``).
       pos: position of ``q`` — a scalar (lockstep: all rows share one
-        position) or an int32 ``[B]`` vector (per-row decode); each
-        row's cache slots beyond its position are masked.
+        position), an int32 ``[B]`` vector (per-row decode), or an int32
+        ``[B, q_len]`` matrix (multi-position verify window: query ``w``
+        attends slots ``<= pos[b, w]``); each row's cache slots beyond
+        its position are masked.
       slot_mask: optional ``[B, T_max]`` per-row slot validity (0/1 or
         bool) — left-padded variable-length prompts leave pad slots in
         the cache, which must never be attended.
@@ -155,8 +170,13 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
     B, H, q_len, hd = q.shape
     hk = k_cache.shape[1]
     grouped = H != hk
+    pos_nd = jnp.ndim(pos)
     if grouped:
-        assert q_len == 1, "GQA cache read expects single-position queries"
+        assert q_len == 1 or pos_nd == 2, (
+            "GQA multi-position cache read needs per-query [B, q_len] pos")
+        # fold the group dim into the (short) query dim: row (g, w) of the
+        # folded query is head g*q_len + w — per-query masks below must
+        # follow the same (g, w) order
         q = q.reshape(B, hk, (H // hk) * q_len, hd)
     # NOTE (measured v5e, 2026-07-30): padding the 1-row query up to a
     # sublane tile speeds the ISOLATED cache read (0.611 -> 0.466 ms for
@@ -170,10 +190,15 @@ def cached_attention(q, k_cache, v_cache, pos, *, scale: float | None = None,
     # cost XLA the in-place update (full cache copy; llama tick 0.559 ->
     # 0.804 ms). Write-then-attend with the kv-pair kernel is the
     # measured-fast form (ops/pallas/cache_update.py).
-    valid = _pos_valid_mask(pos, k_cache.shape[2])
+    valid = (_multi_pos_valid_mask(pos, k_cache.shape[2]) if pos_nd == 2
+             else _pos_valid_mask(pos, k_cache.shape[2]))
     if slot_mask is not None:
         valid = jnp.logical_and(valid,
                                 slot_mask[:, None, None, :].astype(bool))
+    if grouped and q_len > 1:
+        # [B, 1, W, T] -> [B, 1, G*W, T]: folded query row g*W + w needs
+        # mask row w, i.e. the window mask tiled over groups
+        valid = jnp.tile(valid, (1, 1, H // hk, 1))
     out = dot_product_attention(q, k_cache, v_cache, mask=valid,
                                 scale=scale)
     return out.reshape(B, H, q_len, hd) if grouped else out
@@ -224,8 +249,10 @@ def cached_attention_q8(q, cache, pos, *, scale: float | None = None,
     k_q, v_q = cache["k"], cache["v"]
     hk = k_q.shape[1]
     grouped = H != hk
+    pos_nd = jnp.ndim(pos)
     if grouped:
-        assert q_len == 1, "GQA cache read expects single-position queries"
+        assert q_len == 1 or pos_nd == 2, (
+            "GQA multi-position cache read needs per-query [B, q_len] pos")
         q = q.reshape(B, hk, (H // hk) * q_len, hd)
     sc = (hd ** -0.5) if scale is None else scale
     # [B, hk, g, T]: mixed bf16 x int8 dot over hd, batched over (B, hk)
@@ -233,10 +260,15 @@ def cached_attention_q8(q, cache, pos, *, scale: float | None = None,
         q, k_q, dimension_numbers=(((3,), (3,)), ((0, 1), (0, 1))),
         preferred_element_type=jnp.float32) * sc
     scores = scores * cache["k_scale"][:, :, None, :, 0]
-    valid = _pos_valid_mask(pos, k_q.shape[2])
+    valid = (_multi_pos_valid_mask(pos, k_q.shape[2]) if pos_nd == 2
+             else _pos_valid_mask(pos, k_q.shape[2]))
     if slot_mask is not None:
         valid = jnp.logical_and(valid,
                                 slot_mask[:, None, None, :].astype(bool))
+    if grouped and q_len > 1:
+        # folded query row g*W + w takes window-mask row w (see
+        # cached_attention)
+        valid = jnp.tile(valid, (1, 1, H // hk, 1))
     # finite fill, not -inf: a fully-masked row (padded query) must give
     # finite garbage downstream masking absorbs, never NaN — same
     # convention as dot_product_attention above
@@ -301,6 +333,69 @@ def _paged_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
         pool = kv_pool_insert_all(pool, {"kv": jnp.stack([k, v])}, blk, off)
         kv = gather_kv_blocks(pool["kv"], table)
         out = cached_attention(q, kv[0], kv[1], pos, slot_mask=slot_mask)
+    return out, {**pool, "table": table}
+
+
+def cache_verify_and_attend(q, k, v, cache, positions, *, slot_mask=None):
+    """One speculative VERIFY step against the paged pool cache: all ``W``
+    window positions of every row written and attended in a single pass.
+
+    Args:
+      q, k, v: ``[B, H(k), W, hd]`` — the verify window's projections
+        (position ``w`` of row ``b`` is logical slot ``positions[b, w]``).
+      cache: the paged pool format ``{"kv": [2, P, hk, bt, hd],
+        "table": int32 [B, nb]}`` (plus ``"scale"`` for the int8 pool).
+      positions: int32 ``[B, W]`` — consecutive per-row logical slots.
+      slot_mask: optional ``[B, nb * bt]`` per-row slot validity.
+
+    The write is the portable-XLA scatter (the admission idiom): window
+    K/V land at the physical (block, offset) each row's table maps its
+    slots to, with positions at-or-beyond the logical horizon routed to
+    an out-of-range sentinel block id and DROPPED (``mode="drop"``) —
+    drafted tokens can thus never write past a row's allocated extent.
+    Attention then reads the gathered logical view under the per-query
+    staircase mask (:func:`_multi_pos_valid_mask`): query ``w`` sees
+    ``slots <= positions[b, w]``, i.e. the prefix plus the window's own
+    bottom-right-causal triangle — the SAME kv_len/mask semantics as
+    ``W`` sequential decode ticks, which is what makes verify outputs
+    bit-comparable to plain decode. Speculation is a pure read-side
+    rollback: rejecting tokens only rewinds the host's per-row position,
+    stale K/V beyond it is never attended and is overwritten by the next
+    verify. Returns ``(o [B, H, W, hd], new_cache)``."""
+    from distributed_compute_pytorch_tpu.utils.quantize import quantize_kv
+    table = cache["table"]
+    pool = {n: leaf for n, leaf in cache.items() if n != "table"}
+    num_blocks = pool["kv"].shape[1]
+    bt = pool["kv"].shape[3]
+    nb = table.shape[1]
+    t_max = nb * bt
+    # clipped gather THEN sentinel: take_along_axis clamps out-of-range
+    # lookups, so the horizon test must re-route them explicitly
+    blk = jnp.take_along_axis(table, jnp.clip(positions // bt, 0, nb - 1),
+                              axis=1)
+    blk = jnp.where(positions < t_max, blk, num_blocks)   # dropped below
+    off = positions % bt
+
+    def scatter(leaf, upd):
+        # upd [2, B, hk, W, x] -> [B, W, 2, hk, x]: advanced indices at
+        # axes (1, 3) land broadcast-first (the admission scatter idiom)
+        upd = upd.astype(leaf.dtype).transpose(1, 3, 0, 2, 4)
+        return leaf.at[:, blk, :, off, :].set(upd, mode="drop")
+
+    if "scale" in pool:
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        pool = {"kv": scatter(pool["kv"], jnp.stack([kq, vq])),
+                "scale": scatter(pool["scale"], jnp.stack([ks, vs]))}
+        kv = gather_kv_blocks(pool["kv"], table)
+        sc = gather_kv_blocks(pool["scale"], table)
+        view = {"k": kv[0], "v": kv[1], "k_scale": sc[0], "v_scale": sc[1]}
+        out = cached_attention_q8(q, view, positions, slot_mask=slot_mask)
+    else:
+        pool = {"kv": scatter(pool["kv"], jnp.stack([k, v]))}
+        kv = gather_kv_blocks(pool["kv"], table)
+        out = cached_attention(q, kv[0], kv[1], positions,
+                               slot_mask=slot_mask)
     return out, {**pool, "table": table}
 
 

@@ -165,6 +165,7 @@ import inspect
 import threading
 import time
 import warnings
+import weakref
 from dataclasses import dataclass, field
 
 import jax
@@ -176,7 +177,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_compute_pytorch_tpu.core.mesh import (
     constrain, named_sharding, use_mesh)
 from distributed_compute_pytorch_tpu.infer import (
-    _CACHE_SPEC, _POOL_SPEC, sample_rows)
+    _CACHE_SPEC, _POOL_SPEC, sample_rows, verify_sample_rows)
 from distributed_compute_pytorch_tpu.kv_pool import BlockPool, RadixCache
 from distributed_compute_pytorch_tpu.obs import flight
 from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
@@ -185,6 +186,13 @@ from distributed_compute_pytorch_tpu.obs.tracing import instant, span
 from distributed_compute_pytorch_tpu.serve_lifecycle import (
     CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
 from distributed_compute_pytorch_tpu.train.elastic import call_with_timeout
+
+# (model class, model config, block tokens, segment, mesh devices+axes)
+# -> weakref to the first live batcher that jitted programs for that
+# shape family; later identical batchers borrow its bound jit objects
+# instead of re-paying trace+compile (see the __init__ note).
+_PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_LOCK = threading.Lock()
 
 
 @dataclass
@@ -304,6 +312,18 @@ class ContinuousBatcher:
         as one stderr JSON line). ``None`` = off.
       on_heartbeat: the heartbeat callback. Exceptions are swallowed —
         telemetry must never fail a request.
+      speculate: speculative decoding (DESIGN.md "Speculative
+        decoding"): an int ``k`` (draft k tokens per verify step with
+        the self-drafting n-gram proposer) or a full
+        ``spec_decode.SpecConfig``. Each verify step scores the row's
+        current token plus its ``k`` drafts in ONE forward pass and
+        emits the longest accepted prefix plus the model's own token at
+        the first mismatch — the accept rule is EXACT, so outputs stay
+        token-identical to ``speculate=None`` (greedy and sampled;
+        proposer quality only moves throughput). Refused for MoE
+        models (routing is group-dependent, the prefix-cache
+        precedent). Sustained low acceptance auto-disables back to
+        plain segment decode (``SpecConfig.autodisable_*``).
 
     Telemetry (ISSUE 8): every batcher owns a private
     ``obs.metrics.Registry`` (``self.obs``); ``stats``/``waste`` are
@@ -324,7 +344,8 @@ class ContinuousBatcher:
                  prefix_cache: bool = False,
                  pool_blocks: int | None = None,
                  heartbeat_s: float | None = None,
-                 on_heartbeat=None):
+                 on_heartbeat=None,
+                 speculate=None):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -383,6 +404,34 @@ class ContinuousBatcher:
                 "is group-dependent; a cached prefix cannot be skipped "
                 "without changing the suffix's routing group)")
         self.prefix_cache = prefix_cache
+        if speculate is not None:
+            from distributed_compute_pytorch_tpu.spec_decode import (
+                SpecConfig, make_proposer)
+            if not isinstance(speculate, SpecConfig):
+                speculate = SpecConfig(k=int(speculate))
+            if self._block_takes_moe_capacity:
+                # MoE routing is group-dependent: a verify window routes
+                # its k+1 positions as ONE group where tick-by-tick
+                # decode routes them as k+1 groups, so capacity-bound
+                # token drops could diverge from the plain path —
+                # refuse, mirroring the prefix_cache precedent above
+                raise ValueError(
+                    "speculate does not compose with MoE models (routing "
+                    "is group-dependent: a verify window's k+1 positions "
+                    "route as one group, plain decode routes them "
+                    "tick-by-tick, so capacity-bound drops could "
+                    "silently diverge)")
+            if not hasattr(self._block, "verify_step"):
+                raise ValueError(
+                    f"speculate needs a block family with verify_step; "
+                    f"{type(self._block).__name__} has none")
+            self._proposer = make_proposer(speculate)
+        else:
+            self._proposer = None
+        self._spec = speculate
+        self._spec_w = (speculate.k + 1) if speculate is not None else 0
+        self._spec_on = speculate is not None
+        self._spec_win = [0, 0]      # (proposed, accepted) this window
         hk, hd = model.kv_cache_spec()
         if mesh is not None:
             shape = dict(mesh.shape)
@@ -479,6 +528,15 @@ class ContinuousBatcher:
         self._topk = np.zeros((slots,), np.int32)       # 0 = off
         self._topp = np.full((slots,), 2.0, np.float32)  # >= 1 = off
         self._seed = np.zeros((slots,), np.uint32)
+        # host MIRRORS of _cur_tok/_n_logical: the verify path builds
+        # its windows entirely host-side (the accept decision is host
+        # logic anyway — one fetch per verify either way), so in spec
+        # mode the device copies go stale and these are authoritative;
+        # prefill and reconstruction keep both in lockstep, and
+        # auto-disable pushes the mirrors back before plain decode
+        # resumes
+        self._cur_h = np.zeros((slots,), np.int32)
+        self._nlog_h = np.zeros((slots,), np.int32)
         self.ticks = 0             # decode ticks run this session
         self._zero_stats()
         # moe_capacity is STATIC: capacity shapes the routing one-hots, so
@@ -488,11 +546,51 @@ class ContinuousBatcher:
         # wave too — the prefix-cache-off path always compiles the one
         # prompt_buf-wide window, attach waves one program per
         # block-rounded (suffix, prefix) pair.
-        self._admit_c = jax.jit(self._admit_impl, donate_argnums=(1,),
-                                static_argnames=("moe_capacity",))
-        self._segment_c = jax.jit(self._segment_impl, donate_argnums=(1,),
-                                  static_argnames=("sampling",))
-        self._copy_c = jax.jit(self._copy_impl, donate_argnums=(0,))
+        #
+        # Compiled-PROGRAM sharing: jitting bound methods makes every
+        # instance pay its own trace+compile even when an identical
+        # batcher is already warm — and identical batchers are the
+        # common case (a spec-on/off parity pair over one model, a
+        # router's N replicas). Everything the traces read from `self`
+        # is derived from (model class + frozen config, block tokens,
+        # segment length) plus the ambient mesh; ALL remaining
+        # variation — slots, t_max, wave widths, verify W, int8 vs
+        # bf16 params, sampling — arrives through argument avals and
+        # static argnames, which the shared jit keys on itself. A
+        # borrowed bound method keeps its donor alive (incl. the
+        # donor's pool), so the registry holds weakrefs: a donor with
+        # no borrowers frees with its last user.
+        try:
+            key = (type(self.model), self.model.config, self.bt, self.S,
+                   None if mesh is None else
+                   (tuple(mesh.devices.flat), tuple(mesh.axis_names)))
+            hash(key)
+        except (AttributeError, TypeError):
+            # duck-typed model without a hashable frozen config: no
+            # sharing, every instance jits its own programs (the
+            # pre-cache behavior)
+            key = None
+        with _PROGRAM_CACHE_LOCK:
+            ref = _PROGRAM_CACHE.get(key) if key is not None else None
+            donor = ref() if ref is not None else None
+            if donor is not None:
+                self._admit_c = donor._admit_c
+                self._segment_c = donor._segment_c
+                self._copy_c = donor._copy_c
+                self._verify_c = donor._verify_c
+            else:
+                self._admit_c = jax.jit(self._admit_impl,
+                                        donate_argnums=(1,),
+                                        static_argnames=("moe_capacity",))
+                self._segment_c = jax.jit(self._segment_impl,
+                                          donate_argnums=(1,),
+                                          static_argnames=("sampling",))
+                self._copy_c = jax.jit(self._copy_impl, donate_argnums=(0,))
+                self._verify_c = jax.jit(self._verify_impl,
+                                         donate_argnums=(1,),
+                                         static_argnames=("sampling",))
+                if key is not None:
+                    _PROGRAM_CACHE[key] = weakref.ref(self)
 
     def _zero_stats(self):
         # a FRESH per-batcher registry each session: the stats/waste
@@ -529,6 +627,15 @@ class ContinuousBatcher:
         self.waste = obs_metrics.MetricDict(self.obs, "serve.waste.", {
             "planned_ticks": 0, "parked_admission_lag": 0,
             "parked_drain": 0})
+        # speculative-decoding attribution (ISSUE 12): drafts proposed/
+        # accepted, the running acceptance rate, verify columns that
+        # bought no emitted token (the speculation waste), verify
+        # dispatches and tokens they emitted (useful-tokens-per-segment
+        # = emitted_tokens / verify_segments), and auto-disable trips
+        self.spec = obs_metrics.MetricDict(self.obs, "serve.spec.", {
+            "proposed": 0, "accepted": 0, "acceptance_rate": 0.0,
+            "wasted_verify_tokens": 0, "verify_segments": 0,
+            "emitted_tokens": 0, "autodisabled": 0})
         # per-request SLO distributions (serve_lifecycle.RequestResult
         # field docs define the measurement points); seconds, log
         # buckets 1 µs .. 10 ks
@@ -547,6 +654,7 @@ class ContinuousBatcher:
         return {
             "stats": dict(self.stats),
             "waste": dict(self.waste),
+            "spec": dict(self.spec),
             "slo": {name: h.summary() for name, h in self._slo.items()},
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
@@ -604,6 +712,10 @@ class ContinuousBatcher:
         self._topk[:] = 0
         self._topp[:] = 2.0
         self._seed[:] = 0
+        self._cur_h[:] = 0
+        self._nlog_h[:] = 0
+        self._spec_win = [0, 0]
+        self._spec_on = self._spec is not None   # un-stick auto-disable
         self.ticks = 0
         self._zero_stats()
 
@@ -752,6 +864,54 @@ class ContinuousBatcher:
             (jnp.arange(self.S), tick_keys))
         return caches, tok, n_logical, toks.transpose(1, 0)
 
+    def _verify_impl(self, params, caches, tables, toks, positions0,
+                     n_logical, temp, top_k, top_p, seeds,
+                     sampling: bool = False):
+        """Score a whole draft WINDOW in ONE forward pass: ``toks
+        [B, W]`` (column 0 = each row's current token, columns 1..k =
+        its drafts) embeds at logical counts ``n_logical[b] + i`` and
+        writes/attends at slots ``positions0[b] + 1 + i`` — numerically
+        the SAME (position, count) pairs ``W`` sequential
+        :meth:`_segment_impl` ticks would use, through the blocks'
+        ``verify_step`` (per-query staircase attention,
+        ``ops/attention.py::cache_verify_and_attend``).
+
+        Returns ``(caches, true [B, W])`` where ``true[b, i]`` is the
+        target model's OWN next token after consuming window columns
+        ``0..i`` — argmax, or ``infer.verify_sample_rows`` under the
+        exact (seed, tokens-generated) fold-in schedule plain decode
+        uses at those counts. The host accepts the longest prefix where
+        drafts match ``true`` and emits one more: ``true`` at the first
+        mismatch IS the deterministic rejection resample, so emitted
+        streams are bit-identical to ``speculate=None`` by induction —
+        draft quality can only change HOW MANY tokens emit per pass,
+        never which tokens."""
+        model = self.model
+        blocks = params["blocks"]
+        W = toks.shape[1]
+        pos = positions0[:, None] + 1 + jnp.arange(W)[None, :]   # [B, W]
+        npos = n_logical[:, None] + jnp.arange(W)[None, :]       # [B, W]
+        x = constrain(model.embed(params, toks, npos),
+                      P(("data", "fsdp"), None, None))
+        new_caches = []
+        for li in range(self._n_layers):
+            p_l = jax.tree.map(lambda a: a[li], blocks)
+            paged = {**caches[li], "table": tables}
+            x, c2 = self._block.verify_step(p_l, x, paged, pos)
+            new_caches.append(
+                {name: constrain(leaf, _POOL_SPEC)
+                 for name, leaf in c2.items() if name != "table"})
+        logits = model.readout(params, x)                        # [B, W, V]
+        if sampling:
+            base = jax.vmap(jax.random.key)(seeds)
+            keys = jax.vmap(lambda k, n0: jax.vmap(
+                lambda i: jax.random.fold_in(k, n0 + i))(
+                    jnp.arange(W)))(base, n_logical)             # [B, W]
+            true = verify_sample_rows(logits, temp, top_k, top_p, keys)
+        else:
+            true = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return new_caches, true
+
     # ---- host block accounting -------------------------------------------
 
     def _alloc(self, n: int) -> list:
@@ -813,8 +973,32 @@ class ContinuousBatcher:
         """Decode slots a request consumes past its head before its
         row is harvested and freed: the SEGMENT-ROUNDED budget (a row
         runs whole segments; eos can only shorten the output, not the
-        worst-case tick count)."""
+        worst-case tick count). With speculation configured, exactly
+        ``max_new``: verify emission is clamped to the remaining budget
+        at harvest (never segment-rounded), drafted writes past the
+        extent drop at the horizon sentinel or land in trash-table
+        entries, and a post-auto-disable plain tail's overshoot ticks
+        write past the budget only within the row's own tail block or
+        trash — never a shared one (shared full blocks sit at or below
+        the prompt head, strictly inside the extent)."""
+        if self._spec is not None:
+            return max_new
         return -(-max_new // self.S) * self.S
+
+    def load_estimate(self, max_new: int) -> int:
+        """Router-facing cost of serving ``max_new`` tokens here, in
+        device ticks (``serve_router`` load-balances on this): the
+        segment-rounded budget for plain decode; under LIVE speculation,
+        expected verify dispatches times the window width — each verify
+        costs ``k + 1`` tick-equivalents and emits ``1 + rate * k``
+        tokens in expectation, with the batcher's own measured
+        acceptance rate (0 until measured: admitting "speculation may
+        not pay" keeps cold estimates conservative)."""
+        if self._spec is None or not self._spec_on:
+            return -(-max_new // self.S) * self.S
+        rate = min(1.0, max(0.0, float(self.spec["acceptance_rate"])))
+        verifies = int(np.ceil(max_new / (1.0 + rate * self._spec.k)))
+        return max(verifies, 1) * self._spec_w
 
     def _fits(self, req: Request) -> bool:
         return self.Tb + self._rounded_need(req.max_new) <= self.t_max
@@ -1265,14 +1449,239 @@ class ContinuousBatcher:
                 # host observation hook: drills flip drain flags /
                 # cancel requests at a deterministic segment
                 chaos.on_segment(self.stats["segments"])
-            return toks, plan
+            return "plain", toks, plan
+
+        def cow_for_write(plan):
+            """Speculation rollback-safety guard (ISSUE 12): a verify
+            window writes slots ``row_pos+1 .. row_pos+W``, and every
+            block under that span must be EXCLUSIVELY owned before the
+            dispatch. A shared ref there can only be a radix entry whose
+            valid tokens end at or before the row's live position
+            (append-beyond-valid-span), but the invariant is enforced
+            rather than assumed: any refcount>1 block in the write span
+            is copy-on-write'd first — the radix keeps the original
+            (and its bytes: a copy, not a move), the row re-points at
+            its private copy, and content up to the live position is
+            identical, so attached readers and this row's own prefix
+            reads cannot move. Rejected drafts therefore provably never
+            mutate a radix-attached prefix block
+            (``tests/test_kv_pool.py`` drills this)."""
+            pairs = []
+            for b, _ri, _d in plan:
+                slot = table[b]
+                lo = (self._row_pos[b] + 1) // self.bt
+                hi = min((self._row_pos[b] + self._spec_w) // self.bt,
+                         self.nb - 1)
+                for idx in range(lo, hi + 1):
+                    blk = int(self._tables[b, idx])
+                    if (blk == BlockPool.TRASH
+                            or not self._pool.shared(blk)):
+                        continue
+                    dst = self._alloc(1)[0]
+                    pairs.append((blk, dst))
+                    self._tables[b, idx] = dst
+                    slot.blocks[slot.blocks.index(blk)] = dst
+                    self._pool.release([blk])
+            if pairs:
+                self.stats["cow_copies"] += len(pairs)
+                self._copy_blocks(pairs)
+
+        def dispatch_verify():
+            """Dispatch ONE speculative verify step (no fetch): draft
+            ``k`` tokens per live row from its host-tracked history
+            (prompt + emitted), stack them behind the row's current
+            token, and score all ``k + 1`` positions in one compiled
+            forward (``_verify_impl``). Budget decrements at HARVEST by
+            the emitted length — the next window's drafts depend on
+            this one's outcome, so verify steps never overlap (the
+            weight-stream amortisation that overlap bought plain decode
+            is what verification itself provides here)."""
+            W = self._spec_w
+            toks = np.zeros((self.B, W), np.int32)
+            plan = []
+            for b, slot in enumerate(table):
+                if slot.req_index >= 0 and slot.remaining > 0:
+                    ri = slot.req_index
+                    ctx = list(requests[ri].tokens) + slot.out
+                    drafts = [int(t) for t in
+                              self._proposer.propose(ctx, W - 1)][:W - 1]
+                    if len(drafts) < W - 1:
+                        tail = drafts[-1] if drafts else 0
+                        drafts += [tail] * (W - 1 - len(drafts))
+                    toks[b, 0] = self._cur_h[b]
+                    toks[b, 1:] = drafts
+                    plan.append((b, ri, drafts))
+            if not plan:
+                return None
+            # COW BEFORE snapshotting the tables: the dispatch below must
+            # see the post-copy block ids, or this window's col-0 write
+            # would land in the old shared block while the row's table
+            # already points at the copy (which would then be missing it)
+            cow_for_write(plan)
+            pending = (bool(queue) if self.admit_policy == "fifo"
+                       else any(self._fits(requests[i]) for i in queue))
+            active = {b for b, _, _ in plan}
+            tables_now = self._tables.copy()
+            for b in range(self.B):
+                if b not in active:
+                    tables_now[b, :] = BlockPool.TRASH
+                    self._row_pos[b] = 0
+                    key = ("parked_admission_lag" if pending
+                           else "parked_drain")
+                    self.waste[key] += W
+            prof = self._profile_req
+            if prof is not None and not prof["active"]:
+                jax.profiler.start_trace(prof["dir"])
+                prof["active"] = True
+            with span("dispatch_verify", rows=len(plan)):
+                with self._mesh_ctx():
+                    self._caches, true = self._verify_c(
+                        self.params, self._caches,
+                        jnp.asarray(tables_now), jnp.asarray(toks),
+                        jnp.asarray(self._row_pos, jnp.int32),
+                        jnp.asarray(self._nlog_h),
+                        jnp.asarray(self._temp), jnp.asarray(self._topk),
+                        jnp.asarray(self._topp), jnp.asarray(self._seed),
+                        sampling=sampling)
+            if prof is not None and prof["active"]:
+                prof["remaining"] -= 1
+                if prof["remaining"] <= 0:
+                    jax.block_until_ready(true)
+                    jax.profiler.stop_trace()
+                    self._profile_req = None
+            # NOTE: _row_pos does NOT advance here — harvest_verify
+            # moves each row by its ACCEPTED length only (the rollback
+            # is free: garbage K/V beyond the live position is never
+            # attended and the next verify overwrites it)
+            self.ticks += W
+            self.stats["segments"] += 1
+            self.spec["verify_segments"] += 1
+            for _b, _ri, _d in plan:
+                self.waste["planned_ticks"] += W
+            if chaos is not None and chaos.on_segment is not None:
+                chaos.on_segment(self.stats["segments"])
+            return "spec", true, plan
+
+        def maybe_autodisable():
+            """Throughput guard: over each window of
+            ``autodisable_window`` proposed drafts, sustained acceptance
+            below ``autodisable_below`` flips back to plain segment
+            decode (sticky until :meth:`reset`) — a verify step that
+            accepts nothing still streams the weights once, so losing
+            speculation costs nothing but keeping a useless proposer
+            costs the wasted verify columns forever. Outputs are
+            unaffected either way (the accept rule is exact)."""
+            prop, acc = self._spec_win
+            if prop < self._spec.autodisable_window:
+                return
+            rate = acc / prop
+            if rate >= self._spec.autodisable_below:
+                self._spec_win = [0, 0]
+                return
+            self._spec_on = False
+            self._spec_win = [0, 0]
+            self.spec["autodisabled"] += 1
+            instant("spec_autodisable", window_proposed=prop,
+                    window_accepted=acc, rate=round(rate, 4))
+            # the verify path ran entirely off the host mirrors, so the
+            # device _cur_tok/_n_logical are stale — push the mirrors
+            # back so the next plain segment resumes exactly
+            with self._mesh_ctx():
+                self._cur_tok = self._cur_tok.at[:].set(
+                    jnp.asarray(self._cur_h))
+                self._n_logical = self._n_logical.at[:].set(
+                    jnp.asarray(self._nlog_h))
+
+        def harvest_verify(seg):
+            """THE fetch for a verify step: compare each row's drafts to
+            the target's own ``true`` tokens and emit the longest
+            accepted prefix PLUS the ``true`` token at the first
+            mismatch — which IS the deterministic rejection resample
+            (``_verify_impl`` docstring) — clamped to the remaining
+            budget. Every accept/reject decision is host logic over one
+            fetched ``[B, W]`` array; per-row state (position, logical
+            count, current token) advances by the emitted length only,
+            which is the entire rollback."""
+            _kind, true_dev, plan = seg
+            with span("harvest_verify", rows=len(plan)):
+                self.stats["fetches"] += 1
+                if chaos is not None:
+                    chaos.pre_fetch(self.stats["segments"],
+                                    [ri for _, ri, _ in plan])
+
+                def fetch():
+                    if chaos is not None:
+                        chaos.in_fetch(self.stats["segments"])
+                    return np.asarray(true_dev)
+
+                if self.tick_timeout_s is not None:
+                    true_h = call_with_timeout(fetch, self.tick_timeout_s,
+                                               "serve verify harvest")
+                else:
+                    true_h = fetch()
+                now = time.monotonic()
+                W = self._spec_w
+                for b, ri, drafts in plan:
+                    if results[ri] is not None:
+                        continue   # cancelled/timed out while in flight
+                    slot = table[b]
+                    if slot.req_index != ri:
+                        continue
+                    row = true_h[b]
+                    j = 0
+                    while j < W - 1 and drafts[j] == int(row[j]):
+                        j += 1
+                    emit = [int(t) for t in row[:j + 1]][:slot.remaining]
+                    self.spec["proposed"] += W - 1
+                    self.spec["accepted"] += j
+                    self.spec["emitted_tokens"] += len(emit)
+                    self.spec["wasted_verify_tokens"] += W - len(emit)
+                    self._spec_win[0] += W - 1
+                    self._spec_win[1] += j
+                    ticks_charged[ri] += W
+                    slot.remaining -= len(emit)
+                    was_empty = not slot.out
+                    slot.out.extend(emit)
+                    self._row_pos[b] += len(emit)
+                    self._nlog_h[b] += len(emit)
+                    if emit:
+                        self._cur_h[b] = emit[-1]
+                    if (was_empty and slot.out
+                            and first_tok_at[ri] is None):
+                        first_tok_at[ri] = now
+                        self._slo["ttft_s"].record(
+                            max(0.0, now - arrive_at[ri]))
+                    done = slot.remaining <= 0
+                    if (self.eos_id is not None
+                            and self.eos_id in slot.out):
+                        slot.out = slot.out[
+                            :slot.out.index(self.eos_id) + 1]
+                        done = True
+                    if done:
+                        fin(ri, OK, slot.out)
+                        free_row(b)
+                if self.spec["proposed"]:
+                    self.spec["acceptance_rate"] = (
+                        self.spec["accepted"] / self.spec["proposed"])
+                maybe_autodisable()
+
+        def dispatch_next():
+            """Route to the live dispatch flavour: speculative verify
+            while speculation is configured and not auto-disabled,
+            plain segments otherwise."""
+            if self._spec is not None and self._spec_on:
+                return dispatch_verify()
+            return dispatch_segment()
 
         def harvest(seg, overlapped: bool):
             """THE one device->host fetch per segment, under the tick
             watchdog when configured. ``overlapped`` records whether
             the next segment was already dispatched (the counter the
             bench smoke asserts)."""
-            toks, plan = seg
+            if seg[0] == "spec":
+                harvest_verify(seg)
+                return
+            _kind, toks, plan = seg
             with span("harvest", overlapped=overlapped):
                 self.stats["fetches"] += 1
                 if overlapped:
@@ -1383,7 +1792,7 @@ class ContinuousBatcher:
             and the overlap dispatch never calls this (it must not
             block with a harvest pending)."""
             while True:
-                seg = dispatch_segment()
+                seg = dispatch_next()
                 if seg is not None or draining["on"]:
                     return seg
                 now = time.monotonic()
@@ -1406,7 +1815,11 @@ class ContinuousBatcher:
         while seg is not None:
             nxt = None
             try:
-                nxt = dispatch_segment()   # overlap (None: nothing live)
+                if seg[0] == "plain":
+                    # overlap (None: nothing live). Verify steps never
+                    # overlap: the next window's drafts depend on THIS
+                    # harvest's accepted tokens
+                    nxt = dispatch_segment()
                 harvest(seg, overlapped=nxt is not None)
                 fault_state["consecutive"] = 0
             except Exception as e:  # noqa: BLE001 — the fault path:
@@ -1551,6 +1964,8 @@ class ContinuousBatcher:
                 jnp.asarray(n_log, jnp.int32))
         for (b, known, _m) in entries:
             self._row_pos[b] = len(known) - 2    # head_len - 1
+            self._cur_h[b] = known[-1]           # host mirrors (spec path)
+            self._nlog_h[b] = len(known) - 1
 
     def _reconstruct(self, table, requests, fin, free_row) -> None:
         """Device-failure session reconstruction: rebuild every live

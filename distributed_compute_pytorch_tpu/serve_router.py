@@ -406,7 +406,10 @@ class ServeRouter:
             rep = self.replicas[target]
             suffix = max(0, len(cont) - 1
                          - (aff_len if target == best_aff else 0))
-            load[target] += suffix + rep._rounded_need(remaining)
+            # load_estimate, not _rounded_need: a speculating replica's
+            # decode cost is verify dispatches (k+1 ticks each) scaled
+            # by its measured acceptance rate, not segment-rounded ticks
+            load[target] += suffix + rep.load_estimate(remaining)
             out.setdefault(target, []).append(j)
             self.routed_per_replica[target] += 1
         return out

@@ -331,3 +331,136 @@ def test_reconstruction_after_fault_with_shared_blocks():
     assert [r.tokens for r in faulted] == [r.tokens for r in clean]
     assert cb.stats["reconstructions"] == 1
     assert cb.last_slot_leaks == 0 and cb.last_block_leaks == 0
+
+
+# -------------------------------- speculative verify vs sequential ticks
+
+
+def test_pool_shared_probe():
+    pool = BlockPool(5)
+    a, b = pool.alloc(2)
+    assert not pool.shared(a) and not pool.shared(b)
+    pool.acquire(a)                        # a radix entry attaches
+    assert pool.shared(a) and not pool.shared(b)
+    pool.release([a])
+    assert not pool.shared(a)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("grouped", [False, True])
+def test_verify_window_matches_sequential_ticks(quant, grouped):
+    """The verify-step soundness unit: ONE ``cache_verify_and_attend``
+    over a W-wide window == W sequential ``cache_write_and_attend``
+    decode ticks — same written pool bytes, same per-position attention
+    outputs — for the bf16 and int8 pool forms, MHA and GQA, rows at
+    different positions crossing block boundaries mid-window."""
+    from distributed_compute_pytorch_tpu.ops.attention import (
+        cache_verify_and_attend)
+    B, HK, T, BT, HD, W = 2, 2, 16, 8, 64, 3
+    H = 4 if grouped else HK
+    nb, P_ = T // BT, 5
+    table = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    pos0 = jnp.array([3, 6], jnp.int32)    # row 1 crosses into block 4
+    q = _mk((B, H, W, HD), 0)
+    k = _mk((B, HK, W, HD), 1)
+    v = _mk((B, HK, W, HD), 2)
+    if quant:
+        pool = {"kv": (_mk((2, P_, HK, BT, HD), 3) * 40).astype(jnp.int8),
+                "scale": jnp.abs(_mk((2, P_, HK, BT, 1), 4))}
+    else:
+        pool = {"kv": _mk((2, P_, HK, BT, HD), 3)}
+    positions = pos0[:, None] + jnp.arange(W)[None, :]
+    out_w, new_w = jax.jit(cache_verify_and_attend)(
+        q, k, v, {**pool, "table": table}, positions)
+    seq = {**{n: leaf for n, leaf in pool.items()}, "table": table}
+    outs = []
+    step = jax.jit(cache_write_and_attend)
+    for i in range(W):
+        o, seq = step(q[:, :, i:i + 1], k[:, :, i:i + 1], v[:, :, i:i + 1],
+                      seq, pos0 + i)
+        outs.append(o)
+    # outputs: float tolerance only — the grouped fold contracts heads
+    # in a different order than W separate ticks (f32 reassociation);
+    # the written pool bytes below stay EXACT
+    np.testing.assert_allclose(np.asarray(out_w),
+                               np.asarray(jnp.concatenate(outs, axis=2)),
+                               rtol=1e-4, atol=1e-3)
+    for name in pool:
+        np.testing.assert_array_equal(np.asarray(new_w[name]),
+                                      np.asarray(seq[name]),
+                                      err_msg=name)
+
+
+def test_verify_window_drops_writes_past_horizon():
+    """Drafted positions at or beyond the row's logical horizon route
+    to the out-of-range sentinel and are DROPPED: the pool is untouched
+    there, so speculation can never write past a row's allocated
+    extent (the ``_rounded_need`` overshoot-safety contract)."""
+    from distributed_compute_pytorch_tpu.ops.attention import (
+        cache_verify_and_attend)
+    B, HK, BT, HD, W = 1, 1, 4, 8, 3
+    table = jnp.array([[1, 2]], jnp.int32)          # t_max = 8
+    pool = {"kv": jnp.zeros((2, 4, HK, BT, HD), jnp.float32)}
+    q = _mk((B, HK, W, HD), 0)
+    k = jnp.ones((B, HK, W, HD))
+    v = jnp.ones((B, HK, W, HD))
+    positions = jnp.array([[6, 7, 8]], jnp.int32)   # last is OOB
+    _, new = jax.jit(cache_verify_and_attend)(
+        q, k, v, {**pool, "table": table}, positions)
+    kv = np.asarray(new["kv"])
+    assert (kv[:, 2, :, 2:] == 1).all()             # slots 6, 7 landed
+    assert (kv[:, 0] == 0).all() and (kv[:, 3] == 0).all()  # OOB dropped
+
+
+def test_spec_cow_guard_protects_shared_prefix_blocks():
+    """Satellite drill: rows sharing radix prefix blocks speculate with
+    an always-wrong proposer (every draft rejected), the write-side COW
+    guard copies the shared span first, and the radix entries survive
+    uncorrupted — a LATER wave re-attaching the same prefix still
+    serves token-identical to the spec-off reference, with zero
+    leaks."""
+    from distributed_compute_pytorch_tpu.models.gpt2 import (
+        GPT2, GPT2Config)
+    from distributed_compute_pytorch_tpu.serve import (
+        ContinuousBatcher, Request)
+    from distributed_compute_pytorch_tpu.spec_decode import SpecConfig
+
+    class _Wrong:
+        def propose(self, context, k):
+            return [(context[-1] * 31 + 7 * i + 13) % 256
+                    for i in range(k)]
+
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(83)
+    shared = [int(t) for t in rng.integers(0, 256, 19)]   # ends mid-block
+    def wave():
+        return [Request(shared + [int(t)
+                                  for t in rng.integers(0, 256, 2)], 6)
+                for _ in range(4)]
+    rng2 = np.random.default_rng(83)
+    shared2 = [int(t) for t in rng2.integers(0, 256, 19)]
+    assert shared2 == shared
+    w1, w2 = wave(), wave()
+
+    def serve_twice(cb):
+        a = cb.serve([dataclasses.replace(r) for r in w1])
+        b = cb.serve([dataclasses.replace(r) for r in w2])
+        return a + b
+
+    off = ContinuousBatcher(model, params, slots=2, t_max=64,
+                            prompt_buf=24, segment=3, prefix_cache=True)
+    ref = serve_twice(off)
+    spec = SpecConfig(k=3, proposer=_Wrong(),
+                      autodisable_window=10 ** 9)
+    on = ContinuousBatcher(model, params, slots=2, t_max=64,
+                           prompt_buf=24, segment=3, prefix_cache=True,
+                           speculate=spec)
+    got = serve_twice(on)
+    assert got == ref
+    assert on.stats["prefix_hits"] > 0            # blocks genuinely shared
+    # rejected drafts wrote into spans overlapping tree-held blocks:
+    # the guard must have copied MORE than the attach path alone does
+    assert on.stats["cow_copies"] > off.stats["cow_copies"]
+    assert on.spec["wasted_verify_tokens"] > 0    # rejections really ran
+    assert on.last_slot_leaks == 0 and on.last_block_leaks == 0

@@ -346,3 +346,62 @@ def test_router_poisson_drill_with_kill(fleet):
     assert router.stats["failovers"] >= 1
     assert router.stats["migrations"] >= 1
     _assert_no_leaks(fleet)
+
+
+# ------------------------------------------------ speculation failover
+
+
+def test_failover_with_speculation_token_identical():
+    """Satellite drill for the spec PR: a replica set serving with
+    ``speculate`` on (replicas inherit the config; the router's load
+    estimate prices verify windows) loses one replica mid-stream — the
+    migrated sessions finish on the survivors token-identical to an
+    unloaded spec-ON replica, which is itself identical to spec-OFF
+    (the exact accept rule), with zero leaks anywhere."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    spec_fleet = [ContinuousBatcher(model, params, slots=2, t_max=64,
+                                    prompt_buf=12, segment=3,
+                                    prefix_cache=True, max_recoveries=0,
+                                    speculate=2)
+                  for _ in range(3)]
+    rng = np.random.default_rng(19)
+    reqs = _requests(rng, 5, min_new=5, max_new=8)
+    # repetitive rows so the ACCEPT path migrates too, plus a sampled row
+    reqs += [Request(tokens=[7, 3, 9] * 3, max_new=8) for _ in range(2)]
+    reqs[1].temperature = 0.8
+    reqs[1].seed = 321
+    ref = spec_fleet[0].serve_detailed(_copies(reqs))
+    assert all(r.ok for r in ref)
+    assert spec_fleet[0].spec["accepted"] > 0
+    plain = ContinuousBatcher(model, params, slots=2, t_max=64,
+                              prompt_buf=12, segment=3, prefix_cache=True,
+                              max_recoveries=0)
+    res_off = plain.serve_detailed(_copies(reqs))
+    assert [r.tokens for r in ref] == [r.tokens for r in res_off]
+    _reset(spec_fleet)
+    router = ServeRouter(spec_fleet, jitter_seed=42)
+    chaos = {1: ChaosInjector(fault_at_segment=2, fault_mode="raise")}
+    res = router.route(_copies(reqs), chaos=chaos)
+    assert all(r.ok for r in res), [r.error for r in res]
+    assert [r.tokens for r in res] == [r.tokens for r in ref]
+    assert router.stats["failovers"] >= 1
+    assert any(r.migrated for r in res)
+    assert sum(rep.spec["verify_segments"] for rep in spec_fleet) > 0
+    _assert_no_leaks(spec_fleet)
+
+
+def test_router_load_estimate_prices_verify_windows():
+    """The placement cost the router sums per replica comes from
+    ``load_estimate``: a live-spec replica prices ``max_new`` in verify
+    windows (k+1 ticks each), a plain replica in segment-rounded
+    ticks — both monotone in max_new."""
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    plain = ContinuousBatcher(model, params, slots=1, t_max=64,
+                              prompt_buf=8, segment=4)
+    spec = ContinuousBatcher(model, params, slots=1, t_max=64,
+                             prompt_buf=8, segment=4, speculate=3)
+    assert plain.load_estimate(8) == 8
+    assert spec.load_estimate(8) == 8 * 4     # cold: rate 0, windows of 4
+    assert spec.load_estimate(16) > spec.load_estimate(4)
