@@ -25,7 +25,8 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
-	serve-spec-smoke serve-load-smoke serve-router-smoke bench-diff
+	serve-tier-smoke serve-spec-smoke serve-load-smoke \
+	serve-router-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -60,6 +61,15 @@ bench:
 #   prefill_tokens_saved > 0, COW runs, no block/slot leaks, and the
 #   warm-cache admission TTFT proxy is not degraded; records
 #   prefill-bytes-saved
+# - serve-tier: the hierarchical KV spill tier (kv_tier.py) on a
+#   starved device pool with a 3x-oversized hot prefix set cycled
+#   round-robin (the LRU-adversarial Zipf schedule); fails unless
+#   spill-on gets prefix hits where spill-off gets exactly none, the
+#   host+disk tier hit counters are positive with the disk tier
+#   crossed, output is token-identical to tier-off, device occupancy
+#   stays bounded while the host pool absorbs the overflow, the
+#   warm-promote TTFT proxy is not degraded vs cold prefill, and no
+#   slot/device-block/host-block leaks
 # - serve-spec: speculative decoding on a repetitive stream (the
 #   n-gram self-drafting best case with random rejects mixed in);
 #   fails unless spec-on output is token-identical to spec-off (the
@@ -88,6 +98,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --grad-accum-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-chaos-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-tier-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-spec-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
@@ -111,6 +122,9 @@ serve-chaos-smoke:
 
 serve-prefix-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
+
+serve-tier-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-tier-smoke
 
 serve-spec-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-spec-smoke

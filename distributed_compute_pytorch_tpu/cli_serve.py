@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -203,6 +204,24 @@ def main(argv=None) -> int:
                         "the Pallas cache window; rounded up to a "
                         "window multiple). Smaller blocks share "
                         "prefixes at a finer grain")
+    p.add_argument("--host_cache_mb", type=float, default=None,
+                   help="hierarchical KV (kv_tier.py): host-RAM spill "
+                        "tier of this many MB under the radix prefix "
+                        "cache. Evicted refcount-0 prefixes demote D2H "
+                        "instead of being discarded and promote back "
+                        "with one async H2D copy on the next hit — the "
+                        "prefix cache outlives HBM. Outputs stay "
+                        "token-identical. Requires --prefix_cache; "
+                        "with --replicas the budget is PER REPLICA "
+                        "(each owns its own host pool — one process, "
+                        "one failure domain)")
+    p.add_argument("--disk_cache_dir", type=str, default=None,
+                   help="optional third tier below --host_cache_mb: "
+                        "host-LRU prefixes spill to CRC-verified "
+                        "part-NNNNN.npz entries (the v2 shard entry "
+                        "format) in this directory; a corrupt part "
+                        "degrades to a cache miss, never a failure. "
+                        "Replicas spill into replica-N/ subdirectories")
     p.add_argument("--admit_policy", default="fifo",
                    choices=("fifo", "skip_fit"),
                    help="admission order: strict FIFO (fairness: no "
@@ -289,6 +308,14 @@ def main(argv=None) -> int:
     if args.replicas > 1 and args.profile_segments is not None:
         raise SystemExit("--profile_segments profiles one batcher; "
                          "not supported with --replicas > 1")
+    if args.host_cache_mb is not None and not args.prefix_cache:
+        raise SystemExit("--host_cache_mb spills the radix prefix "
+                         "cache: it requires --prefix_cache")
+    if args.host_cache_mb is not None and args.host_cache_mb <= 0:
+        raise SystemExit("--host_cache_mb must be > 0")
+    if args.disk_cache_dir is not None and args.host_cache_mb is None:
+        raise SystemExit("--disk_cache_dir is the tier below host RAM: "
+                         "it requires --host_cache_mb")
     if not 0 <= args.fault_replica < args.replicas:
         raise SystemExit(f"--fault_replica {args.fault_replica} outside "
                          f"[0, {args.replicas})")
@@ -379,6 +406,10 @@ def main(argv=None) -> int:
         if args.heartbeat:
             hb_cb = (on_heartbeat if replica is None else
                      (lambda snap, _r=replica: on_heartbeat(snap, _r)))
+        disk_dir = args.disk_cache_dir
+        if disk_dir is not None and replica is not None:
+            # one failure domain per replica: separate spill directories
+            disk_dir = os.path.join(disk_dir, f"replica-{replica}")
         return ContinuousBatcher(
             model, params, slots=args.slots, t_max=t_max,
             prompt_buf=prompt_buf, segment=args.segment,
@@ -389,6 +420,8 @@ def main(argv=None) -> int:
             max_recoveries=args.max_recoveries,
             kv_block_tokens=args.kv_block_tokens,
             prefix_cache=args.prefix_cache,
+            host_cache_mb=args.host_cache_mb,
+            disk_cache_dir=disk_dir,
             heartbeat_s=args.heartbeat or None,
             on_heartbeat=hb_cb,
             speculate=args.speculate or None)

@@ -51,6 +51,15 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+# Tier states for radix entries (kv_tier.py, DESIGN.md "Hierarchical
+# KV"): DEVICE entries hold pool block refs; HOST/DISK entries keep
+# their position in the tree but their K/V bytes live in the spill
+# tiers (entry.blocks is empty — no pool refs) until a match promotes
+# them back.
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+
 
 class PoolExhausted(RuntimeError):
     """No free block satisfies an allocation — with the serve layer's
@@ -162,6 +171,14 @@ class _Entry:
     blocks: list          # block ids covering ceil(n_tokens / bt)
     n_tokens: int         # valid prefix length the blocks hold
     last_used: int = 0
+    # hierarchical-KV tier state (kv_tier.py): DEVICE entries own one
+    # pool ref per block; demoted entries keep their tree position but
+    # ``blocks`` is empty and the bytes live host-side (``host_blocks``
+    # into the HostBlockPool) or on disk (``disk_key``)
+    tier: str = TIER_DEVICE
+    host_blocks: list = field(default_factory=list)
+    disk_key: "str | None" = None
+    tokens: tuple = ()    # the head sequence (demotion/debug bookkeeping)
 
 
 class RadixCache:
@@ -184,6 +201,10 @@ class RadixCache:
         self.root = _Node()
         self._clock = 0
         self.entries: list[_Entry] = []
+        # tier hook (kv_tier.KVTierManager): called with a demoted
+        # entry whose spill-tier copy must be dropped — a fresh insert
+        # revived it with resident blocks, or eviction discarded it
+        self.on_tier_drop = None
 
     # ---- stats / accounting ------------------------------------------------
 
@@ -197,9 +218,15 @@ class RadixCache:
 
     def clear(self) -> None:
         """Drop every entry (device-failure reconstruction: the pool
-        content is untrusted, so the cache over it is too)."""
+        content is untrusted, so the cache over it is too). Demoted
+        entries drop their spill-tier copies through ``on_tier_drop``
+        — a fault zeroes ALL tiers (the tier manager's own ``reset``
+        is the belt to this suspender)."""
         for e in self.entries:
-            self.pool.release(e.blocks)
+            if e.tier == TIER_DEVICE:
+                self.pool.release(e.blocks)
+            elif self.on_tier_drop is not None:
+                self.on_tier_drop(e)
         self.entries = []
         self.root = _Node()
 
@@ -235,9 +262,12 @@ class RadixCache:
         """Longest cached prefix of ``tokens``: ``(m, blocks)`` with
         ``blocks`` covering ``ceil(m / bt)``; ``(0, [])`` on a miss.
         Refcounts are NOT acquired here — the caller attaches explicitly
-        (it may cap ``m`` further, e.g. to its own head length)."""
+        (it may cap ``m`` further, e.g. to its own head length).
+        DEVICE-resident entries only: a demoted prefix is a miss here —
+        tier-aware callers use :meth:`match_entry`, which can hand back
+        an entry whose bytes need promoting first."""
         node, matched = self._walk(tuple(tokens))
-        entry = self._any_entry(node)
+        entry = self._any_entry(node, device_only=True)
         if entry is None or matched == 0:
             return 0, []
         m = min(matched, entry.n_tokens)
@@ -246,33 +276,60 @@ class RadixCache:
         entry.last_used = self._tick()
         return m, entry.blocks[:-(-m // self.bt)]
 
+    def match_entry(self, tokens) -> tuple[int, "_Entry | None"]:
+        """Tier-aware longest-prefix lookup: ``(m, entry)`` where the
+        entry may be DEVICE-resident (attach its ``blocks`` directly)
+        or demoted to HOST/DISK (the caller promotes it — kv_tier /
+        ``serve._promote_entry`` — before attaching). Device entries
+        win over demoted ones covering the same prefix (promotion is
+        never paid when resident bytes exist). Stamps LRU like
+        :meth:`match`; acquires nothing."""
+        node, matched = self._walk(tuple(tokens))
+        entry = self._any_entry(node)
+        if entry is None or matched == 0:
+            return 0, None
+        m = min(matched, entry.n_tokens)
+        if m == 0:
+            return 0, None
+        entry.last_used = self._tick()
+        return m, entry
+
     def longest_match_len(self, tokens) -> int:
-        """Affinity PROBE: the length :meth:`match` would return, with
-        ZERO side effects — no LRU touch, no refcount change, nothing
-        promoted or evicted. The replica router calls this on every
-        candidate replica per request (``serve_router``), so a probe
-        that mutated LRU order would let routing decisions evict state
-        the loser replicas still want; a probe must observe, never
-        vote. The returned length is a HINT: by admission time the
-        entry may have been evicted, and admission re-``match``es
-        authoritatively."""
+        """Affinity PROBE: the length :meth:`match_entry` would return,
+        with ZERO side effects — no LRU touch, no refcount change,
+        nothing promoted or evicted. The replica router calls this on
+        every candidate replica per request (``serve_router``), so a
+        probe that mutated LRU order would let routing decisions evict
+        state the loser replicas still want; a probe must observe,
+        never vote. ANY tier counts: a host/disk-demoted prefix is
+        still warm for routing purposes — promotion (one H2D copy) is
+        far cheaper than re-prefilling it elsewhere. The returned
+        length is a HINT: by admission time the entry may have been
+        evicted, and admission re-``match``es authoritatively."""
         node, matched = self._walk(tuple(tokens))
         entry = self._any_entry(node)
         if entry is None or matched == 0:
             return 0
         return min(matched, entry.n_tokens)
 
-    def _any_entry(self, node: _Node) -> "_Entry | None":
-        """Any entry in ``node``'s subtree — every path through ``node``
+    def _any_entry(self, node: _Node,
+                   device_only: bool = False) -> "_Entry | None":
+        """An entry in ``node``'s subtree — every path through ``node``
         shares the matched prefix, so any of them can supply its
-        blocks."""
-        stack = [node]
+        blocks. DEVICE-resident entries are preferred (attaching them
+        is free; a demoted one costs a promotion copy);
+        ``device_only`` drops demoted entries entirely (the tier-off
+        :meth:`match` contract)."""
+        stack, demoted = [node], None
         while stack:
             n = stack.pop()
             if n.entry is not None:
-                return n.entry
+                if n.entry.tier == TIER_DEVICE:
+                    return n.entry
+                if demoted is None:
+                    demoted = n.entry
             stack.extend(n.children.values())
-        return None
+        return None if device_only else demoted
 
     # ---- insertion ---------------------------------------------------------
 
@@ -310,10 +367,26 @@ class RadixCache:
             node = child
             i += common
         if node.entry is not None:
+            if node.entry.tier != TIER_DEVICE:
+                # REVIVE: the head was re-prefilled before its demoted
+                # copy was promoted (promotion declined under pool
+                # pressure, or a disk-CRC miss dropped the bytes). The
+                # fresh blocks are authoritative — take them and drop
+                # the spill-tier copy
+                if self.on_tier_drop is not None:
+                    self.on_tier_drop(node.entry)
+                node.entry.blocks = list(blocks)
+                node.entry.tier = TIER_DEVICE
+                node.entry.host_blocks = []
+                node.entry.disk_key = None
+                node.entry.last_used = self._tick()
+                for b in blocks:
+                    self.pool.acquire(b)
+                return True
             node.entry.last_used = self._tick()
             return False
         node.entry = _Entry(blocks=list(blocks), n_tokens=len(tokens),
-                            last_used=self._tick())
+                            last_used=self._tick(), tokens=tokens)
         for b in blocks:
             self.pool.acquire(b)
         self.entries.append(node.entry)
@@ -321,17 +394,43 @@ class RadixCache:
 
     # ---- eviction ----------------------------------------------------------
 
-    def evict_for(self, need_free: int) -> int:
+    def evict_for(self, need_free: int, on_evict=None) -> int:
         """Drop LRU entries until the pool has ``need_free`` free blocks
-        (or the tree is empty). Returns the number of entries evicted.
-        Only refcount-0 blocks actually free — a block shared with a
-        live row stays resident."""
+        (or no DEVICE-resident entry is left). Returns the number of
+        entries evicted. Only refcount-0 blocks actually free — a block
+        shared with a live row stays resident.
+
+        ``on_evict(entry, blocks)`` — the tier demotion hook — runs
+        BEFORE the victim's references are released, with ``blocks``
+        holding only the ids this eviction will actually free (tree
+        refcount 1; blocks a live row still shares are NEVER passed —
+        their bytes survive on device regardless). The hook may capture
+        the entry's K/V (all of ``entry.blocks`` is still valid at call
+        time) and return truthy to DEMOTE: the entry then keeps its
+        place in the tree with its device refs released and ``blocks``
+        emptied — the hook owns setting ``tier``/``host_blocks``.
+        Falsy (or no hook) discards the entry, the pre-tier
+        behaviour."""
         evicted = 0
-        while self.pool.free_count < need_free and self.entries:
-            victim = min(self.entries, key=lambda e: e.last_used)
-            self.entries.remove(victim)
-            self._detach(victim)
-            self.pool.release(victim.blocks)
+        while self.pool.free_count < need_free:
+            resident = [e for e in self.entries if e.tier == TIER_DEVICE]
+            if not resident:
+                break
+            victim = min(resident, key=lambda e: e.last_used)
+            doomed = [b for b in victim.blocks if self.pool.ref[b] == 1]
+            demoted = (on_evict is not None
+                       and bool(on_evict(victim, doomed)))
+            blocks = victim.blocks
+            if demoted:
+                victim.blocks = []
+            else:
+                self.entries.remove(victim)
+                self._detach(victim)
+                if victim.tier != TIER_DEVICE and self.on_tier_drop:
+                    # the hook stored a copy but asked for a discard
+                    # anyway — don't strand spill bytes
+                    self.on_tier_drop(victim)
+            self.pool.release(blocks)
             evicted += 1
         return evicted
 

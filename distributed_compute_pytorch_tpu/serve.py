@@ -178,7 +178,11 @@ from distributed_compute_pytorch_tpu.core.mesh import (
     constrain, named_sharding, use_mesh)
 from distributed_compute_pytorch_tpu.infer import (
     _CACHE_SPEC, _POOL_SPEC, sample_rows, verify_sample_rows)
-from distributed_compute_pytorch_tpu.kv_pool import BlockPool, RadixCache
+from distributed_compute_pytorch_tpu.kv_pool import (
+    TIER_DEVICE, BlockPool, PoolExhausted, RadixCache)
+from distributed_compute_pytorch_tpu.kv_tier import (
+    TIER_STATS, DiskTier, HostBlockPool, KVTierManager,
+    host_blocks_for_mb)
 from distributed_compute_pytorch_tpu.obs import flight
 from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
 from distributed_compute_pytorch_tpu.obs.metrics import device_memory_gauges
@@ -306,6 +310,20 @@ class ContinuousBatcher:
         its worst-case table after LRU eviction — plus 4 rows' worth of
         cache headroom when ``prefix_cache`` is on). Rounded up to a
         batch-axes multiple under a mesh.
+      host_cache_mb: hierarchical KV (``kv_tier``, DESIGN.md
+        "Hierarchical KV"): size of the host-RAM spill pool in MiB.
+        LRU eviction then DEMOTES refcount-0 prefix entries D2H
+        instead of discarding them, and a later match promotes them
+        back with one async H2D copy — the radix working set outlives
+        the device pool. Requires ``prefix_cache``. ``None`` = off
+        (discard-on-evict, the pre-tier behaviour).
+      host_cache_blocks: the same budget in blocks (tests/sizing by
+        hand); wins over ``host_cache_mb``.
+      disk_cache_dir: optional CRC-verified disk tier below the host
+        pool (``part-NNNNN.npz`` + per-entry CRC-32, the v2 shard
+        entry format): host-pool pressure spills LRU demoted entries
+        there; a corrupt part degrades to a cache miss, never a
+        failure. Requires a host tier.
       heartbeat_s: emit a telemetry heartbeat every this many seconds
         of serving: ``on_heartbeat(stats_snapshot())`` runs in the
         scheduler thread between device calls (``dcp-serve`` prints it
@@ -343,6 +361,9 @@ class ContinuousBatcher:
                  kv_block_tokens: int | None = None,
                  prefix_cache: bool = False,
                  pool_blocks: int | None = None,
+                 host_cache_mb: float | None = None,
+                 host_cache_blocks: int | None = None,
+                 disk_cache_dir: str | None = None,
                  heartbeat_s: float | None = None,
                  on_heartbeat=None,
                  speculate=None):
@@ -366,6 +387,24 @@ class ContinuousBatcher:
                 f"kv_block_tokens must be >= 1, got {kv_block_tokens}")
         if heartbeat_s is not None and heartbeat_s <= 0:
             raise ValueError(f"heartbeat_s must be > 0, got {heartbeat_s}")
+        if host_cache_mb is not None and host_cache_mb <= 0:
+            raise ValueError(
+                f"host_cache_mb must be > 0, got {host_cache_mb}")
+        if host_cache_blocks is not None and host_cache_blocks < 1:
+            raise ValueError(
+                f"host_cache_blocks must be >= 1, got {host_cache_blocks}")
+        _tier_on = (host_cache_mb is not None
+                    or host_cache_blocks is not None
+                    or disk_cache_dir is not None)
+        if _tier_on and not prefix_cache:
+            raise ValueError(
+                "host_cache_mb/host_cache_blocks/disk_cache_dir extend "
+                "the radix prefix cache — they require prefix_cache=True")
+        if (disk_cache_dir is not None and host_cache_mb is None
+                and host_cache_blocks is None):
+            raise ValueError(
+                "disk_cache_dir needs a host tier to stage through "
+                "(set host_cache_mb or host_cache_blocks)")
         self.max_pending = max_pending
         self.tick_timeout_s = tick_timeout_s
         self.max_recoveries = max_recoveries
@@ -518,6 +557,21 @@ class ContinuousBatcher:
         self._tables = np.full((slots, self.nb), BlockPool.TRASH, np.int32)
         self._radix = (RadixCache(self._pool, self.bt)
                        if prefix_cache else None)
+        # hierarchical KV (kv_tier.py): a host-RAM block pool (and an
+        # optional CRC-verified disk tier below it) that eviction
+        # demotes into and admission promotes from — the radix working
+        # set outlives the device pool
+        self._tier = None
+        self._tier_promote_t0 = None
+        if _tier_on:
+            np_dtype = np.dtype(dtype)
+            hb = (host_cache_blocks if host_cache_blocks is not None
+                  else host_blocks_for_mb(host_cache_mb, n_layers, hk,
+                                          self.bt, hd, np_dtype.itemsize))
+            self._tier = KVTierManager(
+                self._radix,
+                HostBlockPool(hb, n_layers, hk, self.bt, hd, np_dtype),
+                DiskTier(disk_cache_dir) if disk_cache_dir else None)
         # per-row slot of the last written token (host-tracked: admission
         # rewinds a row to its head length - 1; each segment advances
         # every row by S; parked rows sit at 0 writing into trash)
@@ -578,6 +632,7 @@ class ContinuousBatcher:
                 self._segment_c = donor._segment_c
                 self._copy_c = donor._copy_c
                 self._verify_c = donor._verify_c
+                self._promote_c = donor._promote_c
             else:
                 self._admit_c = jax.jit(self._admit_impl,
                                         donate_argnums=(1,),
@@ -589,6 +644,8 @@ class ContinuousBatcher:
                 self._verify_c = jax.jit(self._verify_impl,
                                          donate_argnums=(1,),
                                          static_argnames=("sampling",))
+                self._promote_c = jax.jit(self._promote_impl,
+                                          donate_argnums=(0,))
                 if key is not None:
                     _PROGRAM_CACHE[key] = weakref.ref(self)
 
@@ -636,6 +693,17 @@ class ContinuousBatcher:
             "proposed": 0, "accepted": 0, "acceptance_rate": 0.0,
             "wasted_verify_tokens": 0, "verify_segments": 0,
             "emitted_tokens": 0, "autodisabled": 0})
+        # hierarchical-KV attribution (ISSUE 13): evictions demoted D2H
+        # instead of discarded, demoted prefixes promoted back, hits per
+        # spill tier, bytes moved each way, the host-side wall the
+        # promotion copy overlapped with admission, and both pools'
+        # peak occupancy. The KVTierManager writes these through the
+        # same dict, so gauges and dict can never disagree.
+        self.tier = obs_metrics.MetricDict(self.obs, "serve.tier.",
+                                           dict(TIER_STATS))
+        if getattr(self, "_tier", None) is not None:
+            self._tier.stats = self.tier
+        self.last_host_block_leaks = 0  # host blocks unaccounted at exit
         # per-request SLO distributions (serve_lifecycle.RequestResult
         # field docs define the measurement points); seconds, log
         # buckets 1 µs .. 10 ks
@@ -655,10 +723,12 @@ class ContinuousBatcher:
             "stats": dict(self.stats),
             "waste": dict(self.waste),
             "spec": dict(self.spec),
+            "tier": dict(self.tier),
             "slo": {name: h.summary() for name, h in self._slo.items()},
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
             "block_leaks": self.last_block_leaks,
+            "host_block_leaks": self.last_host_block_leaks,
             # device memory at snapshot time ({} on CPU/no stats): the
             # heartbeat is often the ONLY live signal a long serve run
             # emits, so HBM pressure must ride it, not just the trainer
@@ -674,7 +744,11 @@ class ContinuousBatcher:
         refcount, so probing every replica per routing decision cannot
         evict or promote anything. 0 with the prefix cache off. The
         head excludes the last prompt token (never prefilled, never
-        cached — ``kv_pool`` module docstring)."""
+        cached — ``kv_pool`` module docstring). Counts ANY tier: a
+        HOST/DISK-demoted prefix (kv_tier.py) reports its full length
+        — promotion is one H2D copy, far cheaper than the re-prefill a
+        cold replica would pay, so the router should treat demoted
+        state as warm."""
         if self._radix is None or len(tokens) < 2:
             return 0
         return self._radix.longest_match_len(list(tokens)[:-1])
@@ -702,6 +776,8 @@ class ContinuousBatcher:
         sessions while paying trace+compile once."""
         if self._radix is not None:
             self._radix.clear()
+        if self._tier is not None:
+            self._tier.reset()
         self._pool.reset()
         self._tables[:] = BlockPool.TRASH
         self._caches = jax.tree.map(jnp.zeros_like, self._caches)
@@ -811,6 +887,22 @@ class ContinuousBatcher:
                 for name, leaf in c.items()})
         return out
 
+    def _promote_impl(self, caches, dst, payload):
+        """Hierarchical-KV promotion: host-tier K/V ``payload
+        [L, 2, M, hk, bt, hd]`` restored into pool blocks ``dst [M]``
+        across every layer, one compiled dispatch per promoted entry.
+        Under a mesh the payload arrives replicated (it was host
+        bytes) and the constrain lands it straight in the block-axis-
+        sharded pool layout — the same portable-redistribution move
+        admission-prefill K/V rides (``_admit_impl``), so each device
+        keeps only its own block shards."""
+        out = []
+        for i, c in enumerate(caches):
+            upd = payload[i].astype(c["kv"].dtype)
+            out.append({"kv": constrain(
+                c["kv"].at[:, dst].set(upd), _POOL_SPEC)})
+        return out
+
     def _segment_impl(self, params, caches, tables, tok, n_logical,
                       positions0, temp, top_k, top_p, seeds,
                       sampling: bool = False):
@@ -917,10 +1009,63 @@ class ContinuousBatcher:
     def _alloc(self, n: int) -> list:
         """Allocate ``n`` fresh blocks, evicting LRU radix entries first
         when the free list runs short (eviction frees refcount-0 blocks
-        only, so live rows are never robbed)."""
+        only, so live rows are never robbed). With the hierarchical-KV
+        tier on, eviction DEMOTES instead of discarding: the victim's
+        K/V is copied D2H into the host pool and its entry stays in the
+        tree, promotable on the next match."""
         if self._pool.free_count < n and self._radix is not None:
-            self._radix.evict_for(n)
+            self._radix.evict_for(
+                n, on_evict=(self._tier_demote if self._tier is not None
+                             else None))
         return self._pool.alloc(n)
+
+    def _tier_demote(self, entry, doomed) -> bool:
+        """``RadixCache.evict_for``'s ``on_evict`` hook: capture the
+        victim's blocks D2H into the host tier. ``doomed`` (the blocks
+        this eviction actually frees) is unused beyond being the
+        hook's contract — the WHOLE entry is captured, because a
+        shared block's device copy survives only as long as its
+        sharing row does, while the demoted entry must outlive both.
+        Truthy return = entry demoted in place of discarded."""
+        content = np.stack(
+            [np.asarray(c["kv"][:, jnp.asarray(entry.blocks, jnp.int32)])
+             for c in self._caches])
+        return self._tier.store(entry, content)
+
+    def _promote_entry(self, entry) -> bool:
+        """Restore a demoted entry's K/V to the device pool: allocate
+        fresh blocks (which may itself demote colder entries), take the
+        bytes from the host/disk tier, and DISPATCH the compiled H2D
+        scatter — asynchronously, so the copy overlaps the admission
+        wave the caller is still assembling host-side (device program
+        order makes the bytes land before the wave's prefill or any
+        attached read; ``promote_overlap_ms`` measures the overlapped
+        window). False = promotion declined (pool pressure: not enough
+        free + evictable blocks) or the disk copy failed its CRC —
+        either way the caller re-prefills, outputs unchanged."""
+        k = -(-entry.n_tokens // self.bt)
+        self._tier.pin = entry      # the alloc below may demote/spill
+        try:                        # colder entries — never this one
+            blocks = self._alloc(k)
+        except PoolExhausted:
+            return False
+        finally:
+            self._tier.pin = None
+        content = self._tier.fetch(entry)
+        if content is None:                  # disk CRC miss: entry gone
+            self._pool.release(blocks)
+            return False
+        t0 = time.monotonic()
+        with self._mesh_ctx():
+            self._caches = self._promote_c(
+                self._caches, jnp.asarray(blocks, jnp.int32),
+                jnp.asarray(content))
+        entry.blocks = blocks                # the tree now owns the refs
+        entry.tier = TIER_DEVICE
+        self.tier["promotions"] += 1
+        if self._tier_promote_t0 is None:
+            self._tier_promote_t0 = t0
+        return True
 
     def _assign_blocks(self, b: int, slot: _Slot, known: list,
                        remaining: int):
@@ -938,7 +1083,18 @@ class ContinuousBatcher:
         nblocks = -(-extent // self.bt)
         m, src = 0, []
         if self._radix is not None:
-            m, src = self._radix.match(head)
+            if self._tier is not None:
+                # tier-aware lookup: a demoted prefix is still a hit —
+                # promote it (one async H2D copy) instead of
+                # re-prefilling; a declined/failed promotion degrades
+                # to a plain miss
+                m, entry = self._radix.match_entry(head)
+                if m and entry.tier != TIER_DEVICE:
+                    if not self._promote_entry(entry):
+                        m, entry = 0, None
+                src = list(entry.blocks) if m else []
+            else:
+                m, src = self._radix.match(head)
             m = min(m, nn)
             src = src[:-(-m // self.bt)] if m else []
         f, r = divmod(m, self.bt)
@@ -1369,6 +1525,15 @@ class ContinuousBatcher:
                 self._prefill_wave(entries)
                 self.stats["prefill_calls"] += 1
                 self.stats["prefill_rows"] += len(take)
+                if self._tier_promote_t0 is not None:
+                    # the wave's promotion H2D copies were dispatched
+                    # back in _assign_blocks and ran while the host
+                    # built + dispatched this prefill — the overlapped
+                    # window, closed here (both dispatches are async;
+                    # device order serialises copy before read)
+                    self.tier["promote_overlap_ms"] += (
+                        time.monotonic() - self._tier_promote_t0) * 1e3
+                    self._tier_promote_t0 = None
                 if self._radix is not None:
                     # the wave's freshly-prefilled heads enter the cache
                     # so later arrivals can attach to them (insert AFTER
@@ -1863,6 +2028,13 @@ class ContinuousBatcher:
         # references are the radix cache's (and the pinned trash block)
         held = self._radix.held() if self._radix is not None else {}
         self.last_block_leaks = self._pool.leak_check(held)
+        # ... and to the HOST pool: every allocated host block must be
+        # owned by exactly one demoted entry (the tier analogue)
+        if self._tier is not None:
+            self.last_host_block_leaks = self._tier.leak_check()
+            self.tier["host_pool_occupancy"] = max(
+                self.tier["host_pool_occupancy"],
+                self._tier.host.high_water / self._tier.host.num_blocks)
         self.stats["block_pool_occupancy"] = max(
             self.stats["block_pool_occupancy"],
             self._pool.high_water / self._pool.num_blocks)
@@ -1997,6 +2169,13 @@ class ContinuousBatcher:
         # releasing them twice.
         if self._radix is not None:
             self._radix.clear()
+        if self._tier is not None:
+            # ALL tiers zero with the device pool: host/disk bytes
+            # physically survive a device fault, but the radix that
+            # indexes them just died — a stale tier entry promoted
+            # after recovery could attach replayed rows to K/V from
+            # the pre-fault session
+            self._tier.reset()
         for slot in table:
             slot.blocks = []
         self._pool.reset()

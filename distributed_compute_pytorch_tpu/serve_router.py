@@ -19,7 +19,11 @@ Dispatch — SLO-aware least-loaded with radix affinity:
   replicas still want). The replica holding the longest cached prefix
   of the request's prompt wins, because a cache hit skips that much
   prefill — cache hit rate is a CLUSTER property once there is more
-  than one pool.
+  than one pool. With the hierarchical KV tier enabled (kv_tier.py:
+  ``--host_cache_mb`` / ``--disk_cache_dir``, each replica owning its
+  own host pool) the probe counts HOST/DISK-demoted prefixes as warm
+  too: promoting spilled bytes back to device is one H2D copy, far
+  cheaper than re-prefilling the prefix on a cold replica.
 - Affinity yields to load: each candidate's backlog is estimated in
   ticks (unshared prefill suffix + segment-rounded decode budget of
   everything already assigned this round, scaled by the replica's

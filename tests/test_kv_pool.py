@@ -166,6 +166,88 @@ def test_radix_probe_never_mutates():
     assert tree.match([9, 9])[0] > 0              # b survives
 
 
+def test_radix_on_evict_never_sees_shared_blocks():
+    """The demotion hook's ``blocks`` argument must hold ONLY the ids
+    this eviction will free (tree refcount 1) — a block a live row
+    still shares keeps its bytes on device, so demoting it would copy
+    state that is not actually leaving. The entry itself is intact at
+    call time (hooks snapshot K/V through ``entry.blocks``)."""
+    pool, tree = _pool_and_tree(bt=4, blocks=8)   # 7 usable
+    a = pool.alloc(3)
+    tree.insert(list(range(12)), a)
+    pool.release(a)                    # row done; tree-only refs
+    pool.acquire(a[0])                 # a live row still shares a[0]
+    seen = []
+
+    def hook(entry, blocks):
+        seen.append((list(entry.blocks), list(blocks)))
+        return False                   # discard (pre-tier behaviour)
+
+    tree.evict_for(pool.free_count + 1, on_evict=hook)
+    assert seen == [(a, a[1:])]        # full entry, doomed-only blocks
+    assert pool.ref[a[0]] == 1         # the sharer keeps its block
+    assert pool.ref[a[1]] == pool.ref[a[2]] == 0
+    pool.release([a[0]])
+    assert pool.leak_check({}) == 0
+
+
+def test_radix_on_evict_falsy_discards_truthy_demotes():
+    """Falsy hook return = the old discard path (entry gone from the
+    tree). Truthy = demote in place: device refs release but the entry
+    keeps its tree position — invisible to tier-off ``match``, visible
+    to ``match_entry`` and the router's ``longest_match_len`` probe."""
+    pool, tree = _pool_and_tree(bt=4, blocks=8)   # 7 usable
+    seq_a, seq_b = list(range(8)), [9, 9] + list(range(6))
+    a = pool.alloc(2)
+    tree.insert(seq_a, a)
+    b = pool.alloc(2)
+    tree.insert(seq_b, b)
+    pool.release(a)
+    pool.release(b)
+    tree.match(seq_b)                  # refresh b: a becomes LRU
+
+    def demote(entry, blocks):
+        entry.tier = "host"            # hook owns the tier flip
+        return True
+
+    # one entry's pressure evicts LRU (a) through the demoting hook
+    tree.evict_for(pool.free_count + 1, on_evict=demote)
+    assert all(pool.ref[x] == 0 for x in a)       # device refs gone
+    assert tree.match(seq_a) == (0, [])           # tier-off: a miss
+    m, entry = tree.match_entry(seq_a)            # tier-aware: warm
+    assert m == 8 and entry is not None and entry.tier == "host"
+    assert entry.blocks == []                     # no device blocks
+    assert tree.longest_match_len(seq_a) == 8     # router sees warm
+    # falsy hook: the next victim (b) is discarded outright
+    tree.evict_for(pool.free_count + 1, on_evict=lambda e, blks: False)
+    assert tree.match_entry(seq_b) == (0, None)
+    assert pool.leak_check(tree.held()) == 0
+
+
+def test_radix_insert_revives_demoted_entry():
+    """Re-prefilling a demoted head takes the fresh device blocks and
+    drops the spill copy (``on_tier_drop`` fires) — the revive path for
+    promotion-declined / CRC-missed entries."""
+    pool, tree = _pool_and_tree(bt=4, blocks=16)
+    seq = list(range(8))
+    a = pool.alloc(2)
+    tree.insert(seq, a)
+    pool.release(a)
+    tree.evict_for(pool.free_count + 1,
+                   on_evict=lambda e, blks: setattr(e, "tier", "host")
+                   or True)
+    dropped = []
+    tree.on_tier_drop = dropped.append
+    fresh = pool.alloc(2)
+    assert tree.insert(seq, fresh)     # revive: True = refs acquired
+    assert len(dropped) == 1 and dropped[0].tier == "device"
+    assert dropped[0].blocks == fresh
+    m, blks = tree.match(seq)
+    assert m == 8 and blks == fresh
+    pool.release(fresh)
+    assert pool.leak_check(tree.held()) == 0
+
+
 # ---------------------------------------------- paged pool write parity
 
 
