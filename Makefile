@@ -26,7 +26,7 @@ SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
 	serve-tier-smoke serve-spec-smoke serve-load-smoke \
-	serve-router-smoke bench-diff
+	serve-router-smoke serve-disagg-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -89,6 +89,16 @@ bench:
 #   goodput scales > 1.5x, goodput stays > 0 through the kill with
 #   every stream token-identical to the unloaded single-replica
 #   reference, sessions migrate, and no survivor leaks a slot/block
+# - serve-disagg: the chunked + disaggregated prefill drill — a mixed
+#   Poisson stream of short requests and bunched ~1.8k-token prompts
+#   served with chunking off/on against a no-long-prompt baseline, then a
+#   3-replica fleet as a unified pool vs a 1-prefill + 2-decode split;
+#   fails unless the chunked decode-tick p99 (harvest-span gaps) stays
+#   within a fixed 4x of the baseline where unchunked blows past it,
+#   TTFT stays finite, chunked/split tokens are identical to the
+#   unchunked/unified references, at least one handoff moves KV blocks
+#   instead of replaying tokens, and nothing leaks a slot or block;
+#   records TTFT p99 unified vs split (the hardware A/B)
 # - bench-diff (last): the regression gate's self-test — one smoke's
 #   record diffed against itself through obs/regress.py must pass
 #   (a gate that flags identical runs is broken)
@@ -102,6 +112,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-spec-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke
 	$(MAKE) bench-diff
 
 # the bench-regression gate (obs/regress.py): BASE/NEW default to a
@@ -134,3 +145,6 @@ serve-load-smoke:
 
 serve-router-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
+
+serve-disagg-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke

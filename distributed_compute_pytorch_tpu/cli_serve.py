@@ -150,6 +150,26 @@ def main(argv=None) -> int:
                    help="with --replicas > 1, which replica the "
                         "injected --fault_at_segment chaos targets "
                         "(drills failover-by-migration)")
+    p.add_argument("--prefill_chunk_tokens", type=int, default=None,
+                   help="chunked prefill: cap each admission wave's "
+                        "prefill at N prompt tokens (rounded up to a "
+                        "KV-block multiple); longer prompts admit "
+                        "their first chunk and extend chunk-by-chunk "
+                        "between decode segments, so one long prompt "
+                        "never stalls live decode rows for a whole "
+                        "prefill. Outputs stay token-identical (greedy "
+                        "AND sampled). Default: unchunked; not "
+                        "supported for --model moe")
+    p.add_argument("--prefill_replicas", type=int, default=0,
+                   help="with --replicas > 1: dedicate the first K "
+                        "replicas to prompt prefill (disaggregated "
+                        "serving). Sessions prefill there, then hop to "
+                        "a decode replica — the finished KV blocks are "
+                        "handed over through the host tier instead of "
+                        "being re-prefilled (falls back to token-"
+                        "identical replay on any miss). Requires "
+                        "--prefix_cache and at least one decode "
+                        "replica. 0 (default) = unified replicas")
     p.add_argument("--t_max", type=int, default=None,
                    help="cache length == total tick horizon (default: "
                         "sized from the workload)")
@@ -319,6 +339,23 @@ def main(argv=None) -> int:
     if not 0 <= args.fault_replica < args.replicas:
         raise SystemExit(f"--fault_replica {args.fault_replica} outside "
                          f"[0, {args.replicas})")
+    if args.prefill_chunk_tokens is not None \
+            and args.prefill_chunk_tokens < 1:
+        raise SystemExit("--prefill_chunk_tokens must be >= 1")
+    if args.prefill_chunk_tokens is not None and args.model == "moe":
+        raise SystemExit("--prefill_chunk_tokens is not supported for "
+                         "--model moe (expert routing is group-"
+                         "dependent, so a chunked prefill would not be "
+                         "token-identical)")
+    if args.prefill_replicas:
+        if not 0 <= args.prefill_replicas < args.replicas:
+            raise SystemExit(f"--prefill_replicas {args.prefill_replicas} "
+                             f"outside [0, {args.replicas}): at least "
+                             f"one decode replica must remain")
+        if not args.prefix_cache:
+            raise SystemExit("--prefill_replicas hands finished KV "
+                             "blocks over through the radix cache: it "
+                             "requires --prefix_cache")
     # SIGTERM/SIGINT -> graceful drain, armed BEFORE the heavy imports /
     # checkpoint load / compiles so a preemption at ANY point of startup
     # drains instead of dying mid-load (the trainer's PreemptionGuard,
@@ -424,13 +461,15 @@ def main(argv=None) -> int:
             disk_cache_dir=disk_dir,
             heartbeat_s=args.heartbeat or None,
             on_heartbeat=hb_cb,
-            speculate=args.speculate or None)
+            speculate=args.speculate or None,
+            prefill_chunk_tokens=args.prefill_chunk_tokens)
 
     router = None
     if args.replicas > 1:
         from distributed_compute_pytorch_tpu.serve_router import ServeRouter
         router = ServeRouter([build_batcher(i)
-                              for i in range(args.replicas)])
+                              for i in range(args.replicas)],
+                             prefill_replicas=args.prefill_replicas)
         cb = router.replicas[0]        # profile/SIGUSR1 target
     else:
         cb = build_batcher()

@@ -333,14 +333,10 @@ class RadixCache:
 
     # ---- insertion ---------------------------------------------------------
 
-    def insert(self, tokens, blocks: list[int]) -> bool:
-        """Record a freshly prefilled head; acquires one pool reference
-        per block. Returns False (and acquires nothing) when the exact
-        sequence is already cached — the existing entry just refreshes
-        its LRU stamp."""
-        tokens = tuple(tokens)
-        assert len(blocks) == -(-len(tokens) // self.bt), (
-            len(tokens), len(blocks), self.bt)
+    def _insert_node(self, tokens: tuple) -> _Node:
+        """Descend (splitting edges as needed) to the node that exactly
+        terminates ``tokens``, creating it if absent — the write-side
+        half of :meth:`insert`, shared with :meth:`insert_demoted`."""
         node, i = self.root, 0
         while i < len(tokens):
             child = node.children.get(tokens[i])
@@ -366,6 +362,17 @@ class RadixCache:
                 continue
             node = child
             i += common
+        return node
+
+    def insert(self, tokens, blocks: list[int]) -> bool:
+        """Record a freshly prefilled head; acquires one pool reference
+        per block. Returns False (and acquires nothing) when the exact
+        sequence is already cached — the existing entry just refreshes
+        its LRU stamp."""
+        tokens = tuple(tokens)
+        assert len(blocks) == -(-len(tokens) // self.bt), (
+            len(tokens), len(blocks), self.bt)
+        node = self._insert_node(tokens)
         if node.entry is not None:
             if node.entry.tier != TIER_DEVICE:
                 # REVIVE: the head was re-prefilled before its demoted
@@ -391,6 +398,30 @@ class RadixCache:
             self.pool.acquire(b)
         self.entries.append(node.entry)
         return True
+
+    def insert_demoted(self, tokens) -> "_Entry | None":
+        """Register a HOST-tier placeholder for ``tokens`` — zero
+        device blocks, zero pool refs; the caller stores the actual
+        bytes through ``kv_tier.KVTierManager.store`` (which flips the
+        bookkeeping exactly as an eviction-path demotion would). The
+        cross-pool import seam: a prefix handed over from ANOTHER
+        batcher's pool enters this tree as if it had been prefilled
+        here and demoted, and the existing promotion path scatters it
+        H2D on first match. Returns None when the sequence is already
+        cached in ANY tier (the existing entry just refreshes its LRU
+        stamp — nothing to store)."""
+        tokens = tuple(tokens)
+        if not tokens:
+            return None
+        node = self._insert_node(tokens)
+        if node.entry is not None:
+            node.entry.last_used = self._tick()
+            return None
+        node.entry = _Entry(blocks=[], n_tokens=len(tokens),
+                            last_used=self._tick(), tier=TIER_HOST,
+                            tokens=tokens)
+        self.entries.append(node.entry)
+        return node.entry
 
     # ---- eviction ----------------------------------------------------------
 

@@ -55,6 +55,8 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 import zlib
 
 import numpy as np
@@ -151,24 +153,79 @@ class DiskTier:
     ``[L, 2, n, hk, bt, hd]``) with a ``part-NNNNN.json`` sidecar
     recording the v2-format entry CRC. Reads verify the CRC against
     the sidecar; ANY mismatch or I/O error degrades to a cache miss —
-    the serving path never raises on tier-3 bytes."""
+    the serving path never raises on tier-3 bytes.
 
-    def __init__(self, root: str):
+    With ``async_writes=True`` (the serve engine's setting) ``put``
+    returns as soon as the bytes are queued: a daemon writer thread
+    does the npz+sidecar I/O off the admission critical path (the
+    async-checkpoint pattern), ``get`` serves still-queued parts from
+    memory, and ``drain()`` blocks until the queue is flat. A failed
+    background write evicts its key from the index, degrading to the
+    same cache miss a corrupt part produces. The caller must not
+    mutate ``content`` after an async ``put`` (the spill path hands
+    over a fresh ``HostBlockPool.read`` copy)."""
+
+    def __init__(self, root: str, async_writes: bool = False):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._seq = 0
         self.index: dict[str, dict] = {}
+        self.async_writes = async_writes
+        self._mu = threading.Lock()
+        self._pending: dict[str, np.ndarray] = {}
+        self._q: queue.Queue = queue.Queue()
+        self._writer: threading.Thread | None = None
+
+    def _write_part(self, key: str, content: np.ndarray,
+                    rec: dict) -> None:
+        np.savez(os.path.join(self.root, key + ".npz"), kv=content)
+        with open(os.path.join(self.root, key + ".json"), "w") as f:
+            json.dump(rec, f)
+
+    def _write_loop(self) -> None:
+        while True:
+            key = self._q.get()
+            try:
+                with self._mu:
+                    content = self._pending.get(key)
+                    rec = self.index.get(key)
+                if content is None or rec is None:
+                    continue         # dropped before the write landed
+                try:
+                    self._write_part(key, content, rec)
+                except Exception:
+                    with self._mu:   # degrade to a miss, never raise
+                        self.index.pop(key, None)
+                with self._mu:
+                    self._pending.pop(key, None)
+                    dead = key not in self.index
+                if dead:             # dropped (or failed) mid-write
+                    for ext in (".npz", ".json"):
+                        try:
+                            os.remove(os.path.join(self.root, key + ext))
+                        except OSError:
+                            pass
+            finally:
+                self._q.task_done()
 
     def put(self, content: np.ndarray) -> str:
         key = f"part-{self._seq:05d}"
         self._seq += 1
-        path = os.path.join(self.root, key + ".npz")
-        np.savez(path, kv=content)
         rec = {"key": key, "crc": _crc(content),
                "shape": list(content.shape), "dtype": str(content.dtype)}
-        with open(os.path.join(self.root, key + ".json"), "w") as f:
-            json.dump(rec, f)
-        self.index[key] = rec
+        if not self.async_writes:
+            self._write_part(key, content, rec)
+            self.index[key] = rec
+            return key
+        with self._mu:
+            self.index[key] = rec
+            self._pending[key] = content
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._write_loop, daemon=True,
+                name="kv-disk-writer")
+            self._writer.start()
+        self._q.put(key)
         return key
 
     def get(self, key: str) -> tuple[np.ndarray | None, bool]:
@@ -176,9 +233,13 @@ class DiskTier:
         True)`` when the part exists but fails its CRC/shape check (or
         cannot be read at all), ``(None, False)`` for an unknown
         key."""
-        rec = self.index.get(key)
+        with self._mu:
+            rec = self.index.get(key)
+            content = self._pending.get(key)
         if rec is None:
             return None, False
+        if content is not None:
+            return content, False    # not yet flushed: memory is truth
         path = os.path.join(self.root, key + ".npz")
         try:
             with np.load(path) as z:
@@ -192,14 +253,25 @@ class DiskTier:
             return None, True
 
     def drop(self, key: str) -> None:
-        self.index.pop(key, None)
+        with self._mu:
+            self.index.pop(key, None)
+            self._pending.pop(key, None)
         for ext in (".npz", ".json"):
             try:
                 os.remove(os.path.join(self.root, key + ext))
             except OSError:
                 pass
 
+    def drain(self) -> None:
+        """Block until every queued async write has hit the disk (or
+        been dropped). reset()/serve-shutdown call this so the part
+        directory is consistent when control returns; sync mode is a
+        no-op."""
+        if self.async_writes:
+            self._q.join()
+
     def reset(self) -> None:
+        self.drain()
         for key in list(self.index):
             self.drop(key)
 

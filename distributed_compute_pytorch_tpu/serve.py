@@ -179,9 +179,10 @@ from distributed_compute_pytorch_tpu.core.mesh import (
 from distributed_compute_pytorch_tpu.infer import (
     _CACHE_SPEC, _POOL_SPEC, sample_rows, verify_sample_rows)
 from distributed_compute_pytorch_tpu.kv_pool import (
-    TIER_DEVICE, BlockPool, PoolExhausted, RadixCache)
+    TIER_DEVICE, TIER_DISK, TIER_HOST, BlockPool, PoolExhausted,
+    RadixCache)
 from distributed_compute_pytorch_tpu.kv_tier import (
-    TIER_STATS, DiskTier, HostBlockPool, KVTierManager,
+    TIER_STATS, DiskTier, HostBlockPool, KVTierManager, _crc,
     host_blocks_for_mb)
 from distributed_compute_pytorch_tpu.obs import flight
 from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
@@ -245,6 +246,12 @@ class _Slot:
     out: list = field(default_factory=list)
     admit_seq: int = -1        # admission order (poison-eviction heuristic)
     blocks: list = field(default_factory=list)   # owned pool block refs
+    # chunked-prefill state (prefill_chunk_tokens): the full known
+    # tokens of a row admitted mid-prompt, and how many logical head
+    # tokens (attached prefix included) are prefilled so far. None =
+    # fully prefilled — the only rows decode plans may include.
+    pf_known: list | None = None
+    pf_done: int = 0
 
     def free(self):
         self.req_index = -1
@@ -252,6 +259,8 @@ class _Slot:
         self.out = []
         self.admit_seq = -1
         self.blocks = []
+        self.pf_known = None
+        self.pf_done = 0
 
 
 class HorizonError(RuntimeError):
@@ -324,6 +333,19 @@ class ContinuousBatcher:
         entry format): host-pool pressure spills LRU demoted entries
         there; a corrupt part degrades to a cache miss, never a
         failure. Requires a host tier.
+      prefill_chunk_tokens: CHUNKED PREFILL (DESIGN.md "Disaggregated
+        and chunked prefill"): bound every prefill wave to about this
+        many suffix tokens (rounded up to the block size). A prompt
+        longer than the budget admits its first chunk only, then
+        extends chunk-by-chunk between decode segments through the
+        same bottom-right-causal ``kv_prefix`` suffix-prefill path an
+        attach wave rides — decode-tick latency stays flat under
+        long-prompt admission storms. Positions are logical and
+        sampling keys depend only on (seed, position), so chunked
+        serving is TOKEN-IDENTICAL to unchunked, greedy or sampled.
+        Refused for MoE models (chunking splits a prompt's routing
+        group — the prefix-cache precedent). ``None`` = off (whole
+        unshared suffixes in one wave, the legacy shape).
       heartbeat_s: emit a telemetry heartbeat every this many seconds
         of serving: ``on_heartbeat(stats_snapshot())`` runs in the
         scheduler thread between device calls (``dcp-serve`` prints it
@@ -364,6 +386,7 @@ class ContinuousBatcher:
                  host_cache_mb: float | None = None,
                  host_cache_blocks: int | None = None,
                  disk_cache_dir: str | None = None,
+                 prefill_chunk_tokens: int | None = None,
                  heartbeat_s: float | None = None,
                  on_heartbeat=None,
                  speculate=None):
@@ -393,6 +416,10 @@ class ContinuousBatcher:
         if host_cache_blocks is not None and host_cache_blocks < 1:
             raise ValueError(
                 f"host_cache_blocks must be >= 1, got {host_cache_blocks}")
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1, got "
+                f"{prefill_chunk_tokens}")
         _tier_on = (host_cache_mb is not None
                     or host_cache_blocks is not None
                     or disk_cache_dir is not None)
@@ -442,6 +469,22 @@ class ContinuousBatcher:
                 "prefix_cache does not compose with MoE models (routing "
                 "is group-dependent; a cached prefix cannot be skipped "
                 "without changing the suffix's routing group)")
+        if prefill_chunk_tokens is not None:
+            if self._block_takes_moe_capacity:
+                # same group-dependence as the prefix-cache refusal: a
+                # chunk routes as its own group where the whole prompt
+                # routed as one, so capacity-bound expert drops could
+                # silently diverge from the unchunked path
+                raise ValueError(
+                    "prefill_chunk_tokens does not compose with MoE "
+                    "models (routing is group-dependent; a chunked "
+                    "prompt cannot reproduce the whole-prompt routing "
+                    "group)")
+            if not self._block_takes_kv_prefix:
+                raise ValueError(
+                    f"prefill_chunk_tokens needs a block family with "
+                    f"kv_prefix suffix-prefill support; "
+                    f"{type(self._block).__name__} has none")
         self.prefix_cache = prefix_cache
         if speculate is not None:
             from distributed_compute_pytorch_tpu.spec_decode import (
@@ -513,6 +556,11 @@ class ContinuousBatcher:
         self.bt = -(-bt // align) * align
         self.t_max = -(-t_max // self.bt) * self.bt
         self.nb = self.t_max // self.bt          # table entries per row
+        # chunked prefill: block-rounded per-WAVE suffix budget (the
+        # chunk is the wave's static window, so rounding keeps the
+        # scatter whole-block and the program count at ~one per mode)
+        self._chunk = (None if prefill_chunk_tokens is None else
+                       -(-prefill_chunk_tokens // self.bt) * self.bt)
         min_blocks = slots * self.nb + 1         # + the trash block
         if pool_blocks is None:
             pool_blocks = min_blocks + (4 * self.nb if prefix_cache else 0)
@@ -571,7 +619,8 @@ class ContinuousBatcher:
             self._tier = KVTierManager(
                 self._radix,
                 HostBlockPool(hb, n_layers, hk, self.bt, hd, np_dtype),
-                DiskTier(disk_cache_dir) if disk_cache_dir else None)
+                DiskTier(disk_cache_dir, async_writes=True)
+                if disk_cache_dir else None)
         # per-row slot of the last written token (host-tracked: admission
         # rewinds a row to its head length - 1; each segment advances
         # every row by S; parked rows sit at 0 writing into trash)
@@ -703,6 +752,18 @@ class ContinuousBatcher:
                                            dict(TIER_STATS))
         if getattr(self, "_tier", None) is not None:
             self._tier.stats = self.tier
+        # chunked/disaggregated prefill attribution (ISSUE 14):
+        # admissions deferred mid-prompt, between-segment extension
+        # waves and the suffix tokens they prefilled, decode ticks a
+        # mid-chunk row sat parked (the latency chunking trades away
+        # from the admission stall), and the router handoff seam —
+        # prefix entries exported/imported as bytes, declines that
+        # fell back to replay, and the bytes moved either way
+        self.prefill = obs_metrics.MetricDict(self.obs, "serve.prefill.", {
+            "chunked_admissions": 0, "chunk_waves": 0,
+            "chunk_tokens": 0, "stall_ticks": 0,
+            "handoff_exports": 0, "handoff_imports": 0,
+            "handoff_declined": 0, "handoff_bytes": 0})
         self.last_host_block_leaks = 0  # host blocks unaccounted at exit
         # per-request SLO distributions (serve_lifecycle.RequestResult
         # field docs define the measurement points); seconds, log
@@ -724,6 +785,7 @@ class ContinuousBatcher:
             "waste": dict(self.waste),
             "spec": dict(self.spec),
             "tier": dict(self.tier),
+            "prefill": dict(self.prefill),
             "slo": {name: h.summary() for name, h in self._slo.items()},
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
@@ -752,6 +814,110 @@ class ContinuousBatcher:
         if self._radix is None or len(tokens) < 2:
             return 0
         return self._radix.longest_match_len(list(tokens)[:-1])
+
+    def export_prefix(self, tokens) -> dict | None:
+        """HANDOFF EXPORT (DESIGN.md "Disaggregated and chunked
+        prefill"): the longest cached prefix of ``tokens``'s prompt
+        head as portable bytes — ``{"tokens", "n_tokens", "kv"
+        [L, 2, nb, hk, bt, hd], "crc", "bt"}`` — for a decode replica
+        to :meth:`import_prefix`. Cached K/V is position-portable
+        (``kv_tier`` module docstring: absolute logical positions,
+        post-projection), so the payload restores bit-exactly into ANY
+        pool's free blocks — a handoff of bytes, not a re-prefill.
+        READ-ONLY: device entries are peeked D2H, demoted entries read
+        without releasing their tier copy. None = nothing to export
+        (cache off, no match, or a disk part failing CRC) — the caller
+        falls back to token-identical replay."""
+        if self._radix is None or len(tokens) < 2:
+            return None
+        head = list(tokens)[:-1]
+        if self._tier is not None:
+            m, entry = self._radix.match_entry(head)
+        else:
+            m, blocks = self._radix.match(head)
+            entry = None
+        m = min(m, len(head))
+        if m < 1:
+            return None
+        k = -(-m // self.bt)
+        if entry is None:                   # tier-off: device blocks
+            content = np.stack(
+                [np.asarray(c["kv"][:, jnp.asarray(blocks[:k],
+                                                   jnp.int32)])
+                 for c in self._caches])
+        elif entry.tier == TIER_DEVICE:
+            content = np.stack(
+                [np.asarray(c["kv"][:, jnp.asarray(entry.blocks[:k],
+                                                   jnp.int32)])
+                 for c in self._caches])
+        elif entry.tier == TIER_HOST:
+            content = self._tier.host.read(entry.host_blocks[:k])
+        else:                               # TIER_DISK
+            got, _corrupt = self._tier.disk.get(entry.disk_key)
+            if got is None:
+                return None                 # CRC miss: caller replays
+            content = got[:, :, :k]
+        self.prefill["handoff_exports"] += 1
+        self.prefill["handoff_bytes"] += int(content.nbytes)
+        return {"tokens": tuple(head[:m]), "n_tokens": m,
+                "kv": content, "crc": _crc(content), "bt": self.bt}
+
+    def import_prefix(self, payload) -> bool:
+        """HANDOFF IMPORT: land an :meth:`export_prefix` payload in
+        THIS batcher's prefix cache so the next admission of the same
+        prompt attaches instead of re-prefilling. With a host tier the
+        bytes register as a demoted entry (zero device blocks now; the
+        existing PR 13 promotion scatters them H2D on first match);
+        tier-less they scatter straight into freshly allocated pool
+        blocks. False = declined — CRC/shape/layout mismatch or pool
+        pressure — and nothing changed: the caller's token-identical
+        replay fallback costs only the compute the handoff would have
+        saved."""
+        if self._radix is None or not payload:
+            return False
+        kv = payload.get("kv")
+        n = int(payload.get("n_tokens", 0))
+        toks = tuple(payload.get("tokens", ()))
+        cache = self._caches[0]["kv"]
+        want = (len(self._caches), 2, -(-n // self.bt),
+                cache.shape[2], self.bt, cache.shape[4])
+        if (kv is None or n < 1 or len(toks) != n
+                or payload.get("bt") != self.bt
+                or tuple(kv.shape) != want
+                or payload.get("crc") != _crc(kv)):
+            self.prefill["handoff_declined"] += 1
+            return False
+        if self._tier is not None:
+            entry = self._radix.insert_demoted(toks)
+            if entry is None:      # already cached here: a handoff hit
+                self.prefill["handoff_imports"] += 1
+                return True
+            if self._tier.store(entry, np.asarray(kv)):
+                self.prefill["handoff_imports"] += 1
+                self.prefill["handoff_bytes"] += int(kv.nbytes)
+                return True
+            # no host room even after spilling: drop the placeholder
+            # (a tier-less entry left in the tree would crash a later
+            # fetch) and fall through to the direct-device path
+            self._tier._remove(entry)
+        k = -(-n // self.bt)
+        try:
+            blocks = self._alloc(k)
+        except PoolExhausted:
+            self.prefill["handoff_declined"] += 1
+            return False
+        with self._mesh_ctx():
+            self._caches = self._promote_c(
+                self._caches, jnp.asarray(blocks, jnp.int32),
+                jnp.asarray(kv))
+        # the tree owns the refs from here; drop the alloc's. insert
+        # returning False (exact duplicate raced in) release the blocks
+        # to garbage — harmless, they are free and unreferenced
+        self._radix.insert(toks, blocks)
+        self._pool.release(blocks)
+        self.prefill["handoff_imports"] += 1
+        self.prefill["handoff_bytes"] += int(kv.nbytes)
+        return True
 
     def profile_next(self, segments: int, profile_dir: str) -> None:
         """Arm ON-DEMAND XLA profiling: the next ``segments``
@@ -1156,6 +1322,22 @@ class ContinuousBatcher:
         verifies = int(np.ceil(max_new / (1.0 + rate * self._spec.k)))
         return max(verifies, 1) * self._spec_w
 
+    def prefill_cost(self, suffix_tokens: int) -> int:
+        """Router-facing cost of prefilling ``suffix_tokens`` uncached
+        prompt tokens here, in the same tick units as
+        :meth:`load_estimate`. Unchunked, a wave prefills the whole
+        suffix in one stall — one token ≈ one tick of decode latency
+        stolen from the live rows. CHUNKED, the suffix spreads over
+        ``ceil(suffix / chunk)`` bounded waves, each riding one
+        decode-segment gap — so the placement cost is segments, not
+        tokens, and a long prompt no longer scares the load balancer
+        off a chunking replica (the ISSUE 14 pricing fix)."""
+        if suffix_tokens <= 0:
+            return 0
+        if self._chunk is None:
+            return suffix_tokens
+        return -(-suffix_tokens // self._chunk) * self.S
+
     def _fits(self, req: Request) -> bool:
         return self.Tb + self._rounded_need(req.max_new) <= self.t_max
 
@@ -1483,7 +1665,11 @@ class ContinuousBatcher:
             a free row (the batched admission: k admissions, 1 dispatch).
             Radix attach + block allocation + COW copies happen here, on
             the host, before the wave's device work. All host->device,
-            no fetch."""
+            no fetch. With CHUNKED PREFILL on, the wave shares one
+            suffix-token budget: rows past it admit mid-prompt (their
+            slot carries the progress mark) and extend between decode
+            segments via ``chunk_wave`` — a long-prompt admission storm
+            can never widen a single wave past the chunk."""
             free = [b for b, s in enumerate(table) if s.req_index < 0]
             take = pick_admissions(len(free))
             if not take:
@@ -1492,6 +1678,7 @@ class ContinuousBatcher:
                 now = time.monotonic()
                 rows = free[:len(take)]
                 entries, cow_all = [], []
+                budget = self._chunk
                 for b, ri in zip(rows, take):
                     req = requests[ri]
                     admit_at[ri] = now
@@ -1518,7 +1705,17 @@ class ContinuousBatcher:
                         self.stats["prefix_hits"] += 1
                     self.stats["cached_prefix_tokens"] += m
                     self.stats["prefill_tokens_saved"] += m
-                    entries.append((b, list(req.tokens), m))
+                    head_len = len(req.tokens) - 1
+                    upto = head_len
+                    if budget is not None:
+                        give = min(head_len - m, budget)
+                        budget -= give
+                        upto = m + give
+                        if upto < head_len:
+                            slot.pf_known = list(req.tokens)
+                            slot.pf_done = upto
+                            self.prefill["chunked_admissions"] += 1
+                    entries.append((b, list(req.tokens), m, upto))
                 self.stats["cow_copies"] += len(cow_all)
                 if cow_all:
                     self._copy_blocks(cow_all)
@@ -1539,8 +1736,58 @@ class ContinuousBatcher:
                     # so later arrivals can attach to them (insert AFTER
                     # the prefill dispatch: device order makes the
                     # blocks valid before any attacher's wave can read
-                    # them)
-                    for b, known, m in entries:
+                    # them). Mid-chunk rows DEFER their insert to the
+                    # extension wave that finishes the head — a partial
+                    # head in the tree would hand attachers blocks whose
+                    # tail is still unwritten.
+                    for b, known, m, upto in entries:
+                        head = known[:-1]
+                        if head and upto >= len(known) - 1:
+                            nb_head = -(-len(head) // self.bt)
+                            self._radix.insert(
+                                head, [int(x) for x in
+                                       self._tables[b, :nb_head]])
+
+        def chunk_wave():
+            """ONE chunk-budgeted extension prefill for every row
+            admitted mid-prompt (``prefill_chunk_tokens``): advance
+            each pending row's prefill by up to the shared budget
+            through the same ``kv_prefix`` suffix path an attach wave
+            rides, finalising rows that reach their head (they join the
+            next decode plan; their head enters the radix cache only
+            now, once every block is written). Called between decode
+            segments — each admission storm costs the decode rows one
+            bounded wave per gap, never one whole-prompt prefill."""
+            if self._chunk is None:
+                return
+            budget = self._chunk
+            entries = []
+            for b, slot in enumerate(table):
+                if slot.req_index < 0 or slot.pf_known is None:
+                    continue
+                head_len = len(slot.pf_known) - 1
+                give = min(head_len - slot.pf_done, budget)
+                if give <= 0:
+                    continue       # this wave's budget is spent
+                budget -= give
+                entries.append((b, slot.pf_known, slot.pf_done,
+                                slot.pf_done + give))
+                slot.pf_done += give
+            if not entries:
+                return
+            with span("chunk_wave", rows=len(entries)):
+                self._prefill_wave(entries)
+                self.stats["prefill_calls"] += 1
+                self.prefill["chunk_waves"] += 1
+                self.prefill["chunk_tokens"] += sum(
+                    upto - m for _, _, m, upto in entries)
+                for b, known, _m, upto in entries:
+                    if upto < len(known) - 1:
+                        continue               # still mid-prompt
+                    slot = table[b]
+                    slot.pf_known = None
+                    slot.pf_done = 0
+                    if self._radix is not None:
                         head = known[:-1]
                         if head:
                             nb_head = -(-len(head) // self.bt)
@@ -1558,10 +1805,13 @@ class ContinuousBatcher:
             free) are parked at position 0 with their table swapped for
             the all-trash row, so their garbage writes land in the
             reserved trash block and can never touch a live or cached
-            block."""
+            block. Rows still mid-chunk (``pf_known``) park too: their
+            head is not fully prefilled, so a decode tick would attend
+            unwritten K/V."""
             plan = []
             for b, slot in enumerate(table):
-                if slot.req_index >= 0 and slot.remaining > 0:
+                if (slot.req_index >= 0 and slot.remaining > 0
+                        and slot.pf_known is None):
                     take = min(slot.remaining, self.S)
                     plan.append((b, slot.req_index, take,
                                  slot.remaining - take <= 0))
@@ -1578,6 +1828,8 @@ class ContinuousBatcher:
                     key = ("parked_admission_lag" if pending
                            else "parked_drain")
                     self.waste[key] += self.S
+                    if table[b].pf_known is not None:
+                        self.prefill["stall_ticks"] += self.S
             prof = self._profile_req
             if prof is not None and not prof["active"]:
                 # profile_next() armed mid-run: open the XLA trace just
@@ -1665,7 +1917,8 @@ class ContinuousBatcher:
             toks = np.zeros((self.B, W), np.int32)
             plan = []
             for b, slot in enumerate(table):
-                if slot.req_index >= 0 and slot.remaining > 0:
+                if (slot.req_index >= 0 and slot.remaining > 0
+                        and slot.pf_known is None):
                     ri = slot.req_index
                     ctx = list(requests[ri].tokens) + slot.out
                     drafts = [int(t) for t in
@@ -1694,6 +1947,8 @@ class ContinuousBatcher:
                     key = ("parked_admission_lag" if pending
                            else "parked_drain")
                     self.waste[key] += W
+                    if table[b].pf_known is not None:
+                        self.prefill["stall_ticks"] += W
             prof = self._profile_req
             if prof is not None and not prof["active"]:
                 jax.profiler.start_trace(prof["dir"])
@@ -1955,11 +2210,23 @@ class ContinuousBatcher:
             ``police``) and admit. The legacy all-at-submission shape
             never waits — every queued request has already arrived —
             and the overlap dispatch never calls this (it must not
-            block with a harvest pending)."""
+            block with a harvest pending). Rows still mid-chunk keep
+            prefilling here even when no row can decode (or the drain
+            latch is on): each ``chunk_wave`` advances the first
+            pending row by at least one block, so the loop always
+            terminates — in a finalised row or a drain-deadline
+            ``police`` free."""
             while True:
                 seg = dispatch_next()
-                if seg is not None or draining["on"]:
+                if seg is not None:
                     return seg
+                if any(s.req_index >= 0 and s.pf_known is not None
+                       for s in table):
+                    chunk_wave()
+                    police()
+                    continue
+                if draining["on"]:
+                    return None
                 now = time.monotonic()
                 future = [arrive_at[i] for i in queue
                           if arrive_at[i] > now]
@@ -1971,11 +2238,13 @@ class ContinuousBatcher:
                 time.sleep(min(min(future) - now, 0.02))
                 police()
                 admit_wave()
+                chunk_wave()
 
         # ---- the overlapped loop: dispatch N+1 BEFORE fetching N,
         # every device interaction under the fault/recovery wrap ----
         police()
         admit_wave()
+        chunk_wave()
         seg = dispatch_or_wait()
         while seg is not None:
             nxt = None
@@ -1996,6 +2265,7 @@ class ContinuousBatcher:
                     break
             police()
             admit_wave()                   # freed rows -> next wave
+            chunk_wave()                   # mid-chunk rows -> next chunk
             if nxt is None:
                 nxt = dispatch_or_wait()   # revived by fresh admissions,
                                            # post-reconstruction, or the
@@ -2031,6 +2301,10 @@ class ContinuousBatcher:
         # ... and to the HOST pool: every allocated host block must be
         # owned by exactly one demoted entry (the tier analogue)
         if self._tier is not None:
+            if self._tier.disk is not None:
+                # flush the async spill writer so the part directory is
+                # consistent (and CRC-verifiable) when serve() returns
+                self._tier.disk.drain()
             self.last_host_block_leaks = self._tier.leak_check()
             self.tier["host_pool_occupancy"] = max(
                 self.tier["host_pool_occupancy"],
@@ -2058,29 +2332,45 @@ class ContinuousBatcher:
 
     def _prefill_wave(self, entries, window: int | None = None):
         """ONE compiled multi-row prefill of ``entries`` ``(row,
-        known_tokens, cached_prefix_m)``: every entry's unshared SUFFIX
-        (tokens past its attached prefix, minus the last token) lands
-        from column 0 of a static ``window``-wide batch and scatters
-        into the row's table-mapped blocks; the last known token becomes
-        the row's current token and the row rewinds to ``head_len - 1``.
+        known_tokens, from_m, upto)``: every entry's head tokens
+        ``known[from_m:upto]`` (logical positions ``from_m..upto-1``,
+        past its already-resident prefix) land from column 0 of a
+        static ``window``-wide batch and scatter into the row's
+        table-mapped blocks. ``from_m`` is the attached-prefix length
+        at admission, or the chunked-prefill progress mark on an
+        extension wave — the bottom-right-causal ``kv_prefix`` mask
+        makes both the same computation. An entry REACHING its head
+        (``upto == head_len``) finalises: the last known token becomes
+        the row's current token and the row rewinds to ``head_len -
+        1``; a mid-chunk entry leaves the row parked for its next
+        extension wave.
 
         ``window`` defaults to ``prompt_buf`` when no entry attaches
         (the one stable admission shape, exactly the pre-paged compile
         behaviour) and to the block-rounded longest suffix otherwise;
-        reconstruction passes the width its grown prefixes need. Rows
-        whose head is fully cached contribute zero suffix tokens — a
-        wave that is ALL attach skips the device prefill entirely (the
-        block lookup IS the admission). Pure dispatch — no fetch."""
-        suffixes = [len(known) - 1 - m for _, known, m in entries]
-        max_m = max(m for _, _, m in entries)
+        with CHUNKING on it is the chunk itself, and the prefix gather
+        spans the full table (``Lp = t_max``, garbage hidden by
+        ``prefix_mask``) — every chunk position compiles the same ~one
+        program instead of one per block-rounded (suffix, prefix)
+        pair. Reconstruction passes the width its grown prefixes need.
+        Rows whose head is fully cached contribute zero suffix tokens
+        — a wave that is ALL attach skips the device prefill entirely
+        (the block lookup IS the admission). Pure dispatch — no
+        fetch."""
+        suffixes = [upto - m for _, _, m, upto in entries]
+        max_m = max(m for _, _, m, _ in entries)
         if window is None:
-            window = (self.Tb if max_m == 0 else
-                      max(self.bt,
-                          -(-max(suffixes) // self.bt) * self.bt))
+            if self._chunk is not None:
+                window = self._chunk
+            else:
+                window = (self.Tb if max_m == 0 else
+                          max(self.bt,
+                              -(-max(suffixes) // self.bt) * self.bt))
         Lp = -(-max_m // self.bt) * self.bt
-        rows = [b for b, _, _ in entries]
-        lasts = [known[-1] for _, known, _ in entries]
-        n_log = [len(known) - 1 for _, known, _ in entries]
+        if self._chunk is not None and max_m:
+            Lp = self.t_max
+        final = [(b, known) for b, known, _m, upto in entries
+                 if upto >= len(known) - 1]
         if max(suffixes) > 0:
             K = len(entries)
             # pad the wave to a multiple of the batch-axes product: pad
@@ -2099,9 +2389,8 @@ class ContinuousBatcher:
             tables_wave = np.full((Kp, self.nb), BlockPool.TRASH,
                                   np.int32)
             caps = []
-            for j, (b, known, m) in enumerate(entries):
-                head = known[:-1]
-                suf = head[m:]
+            for j, (b, known, m, upto) in enumerate(entries):
+                suf = known[m:upto]
                 sn = len(suf)
                 if sn:
                     prompt[j, :sn] = suf
@@ -2128,16 +2417,19 @@ class ContinuousBatcher:
                     jnp.asarray(prompt), jnp.asarray(pmask),
                     jnp.asarray(positions), jnp.asarray(prefix_mask),
                     jnp.asarray(blk_idx), jnp.asarray(off_idx), **kw)
-        rows_j = jnp.asarray(rows, jnp.int32)
-        with self._mesh_ctx():
-            self._cur_tok = self._cur_tok.at[rows_j].set(
-                jnp.asarray(lasts, jnp.int32))
-            self._n_logical = self._n_logical.at[rows_j].set(
-                jnp.asarray(n_log, jnp.int32))
-        for (b, known, _m) in entries:
-            self._row_pos[b] = len(known) - 2    # head_len - 1
-            self._cur_h[b] = known[-1]           # host mirrors (spec path)
-            self._nlog_h[b] = len(known) - 1
+        if final:
+            rows_j = jnp.asarray([b for b, _ in final], jnp.int32)
+            lasts = [known[-1] for _, known in final]
+            n_log = [len(known) - 1 for _, known in final]
+            with self._mesh_ctx():
+                self._cur_tok = self._cur_tok.at[rows_j].set(
+                    jnp.asarray(lasts, jnp.int32))
+                self._n_logical = self._n_logical.at[rows_j].set(
+                    jnp.asarray(n_log, jnp.int32))
+            for b, known in final:
+                self._row_pos[b] = len(known) - 2  # head_len - 1
+                self._cur_h[b] = known[-1]     # host mirrors (spec path)
+                self._nlog_h[b] = len(known) - 1
 
     def _reconstruct(self, table, requests, fin, free_row) -> None:
         """Device-failure session reconstruction: rebuild every live
@@ -2188,6 +2480,11 @@ class ContinuousBatcher:
         for b, slot in enumerate(table):
             if slot.req_index < 0:
                 continue
+            # a row that was mid-chunk replays its WHOLE head in one
+            # wave below (rare path; token-identical either way) — its
+            # chunk progress died with the device buffers
+            slot.pf_known = None
+            slot.pf_done = 0
             req = requests[slot.req_index]
             known = list(req.tokens) + list(slot.out)
             head = len(known) - 1
@@ -2210,7 +2507,7 @@ class ContinuousBatcher:
                 # the radix was cleared, so these allocations are always
                 # fresh blocks (m == 0) — replay never trusts dead K/V
                 self._assign_blocks(b, slot, known, remaining)
-            self._prefill_wave([(b, known, 0)
+            self._prefill_wave([(b, known, 0, len(known) - 1)
                                 for b, _, known, _ in rows], W)
             for b, slot, known, remaining in rows:
                 # host-known truth: the in-flight plan's budget

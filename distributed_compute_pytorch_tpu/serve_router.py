@@ -91,9 +91,10 @@ import time
 from dataclasses import dataclass, field, replace
 
 from distributed_compute_pytorch_tpu.obs import flight
+from distributed_compute_pytorch_tpu.obs.tracing import instant
 from distributed_compute_pytorch_tpu.serve import Request
 from distributed_compute_pytorch_tpu.serve_lifecycle import (
-    CANCELLED, FAILED, SHED, TIMEOUT, RequestResult)
+    CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
 from distributed_compute_pytorch_tpu.train.elastic import (
     backoff_delays, retry_with_backoff)
 
@@ -178,6 +179,10 @@ class _Session:
     arrive_abs: float                  # absolute arrival instant
     deadline_at: float | None          # absolute deadline (None = none)
     tokens: list = field(default_factory=list)   # generated so far
+    # "prefill" until the prompt has been prefilled somewhere; with a
+    # prefill tier configured, such sessions are placed on prefill
+    # replicas and hop to the decode tier right after their first token
+    phase: str = "decode"
     migrated: int = 0
     rounds: int = 0                    # placements attempted
     ticks: int = 0
@@ -218,11 +223,23 @@ class ServeRouter:
                  affinity_max_extra_ticks: int | None = None,
                  heartbeat_stale_s: float | None = None,
                  max_failover_rounds: int | None = None,
+                 prefill_replicas: int = 0,
                  sleep=time.sleep):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
         n = len(self.replicas)
+        # disaggregated prefill: replicas [0, prefill_replicas) form the
+        # prefill tier — sessions placed there always migrate to a
+        # decode replica right after their prompt finishes prefilling,
+        # carrying the finished KV blocks as a host-tier handoff
+        # (export_prefix -> import_prefix) instead of a token replay.
+        # At least one decode replica must remain.
+        if not 0 <= prefill_replicas < n:
+            raise ValueError(f"prefill_replicas must be in [0, {n}), got "
+                             f"{prefill_replicas}")
+        self.prefill_replicas = prefill_replicas
+        self._prefill_set = frozenset(range(prefill_replicas))
         self.probe_budget = probe_budget
         self.probe_base_delay_s = probe_base_delay_s
         self.jitter_seed = jitter_seed
@@ -251,7 +268,9 @@ class ServeRouter:
         self.stats = {"routed": 0, "affinity_routed": 0, "rounds": 0,
                       "failovers": 0, "migrations": 0, "full_replays": 0,
                       "failover_sheds": 0, "takeovers": 0, "probes": 0,
-                      "probe_successes": 0, "unplaceable": 0}
+                      "probe_successes": 0, "unplaceable": 0,
+                      "prefill_hops": 0, "handoffs": 0,
+                      "handoff_fallbacks": 0}
         for i, rep in enumerate(self.replicas):
             self._wire_heartbeat(i, rep)
 
@@ -387,19 +406,26 @@ class ServeRouter:
         healthy = self.healthy_replicas()
         if not healthy:
             return None
+        # tier split: prefill-phase sessions go to healthy prefill
+        # replicas, everything else to the decode tier; either tier
+        # empty degrades to the full healthy set (unified behaviour)
+        h_pre = [i for i in healthy if i in self._prefill_set]
+        h_dec = [i for i in healthy if i not in self._prefill_set]
         load = {i: 0.0 for i in healthy}    # assigned ticks this round
         scale = {i: self._tpot_scale(i) for i in healthy}
         out: dict[int, list[int]] = {}
         for j in order:
             sess = sessions[j]
+            cand = (h_pre if sess.phase == "prefill" and h_pre
+                    else (h_dec or healthy))
             cont = list(sess.req.tokens) + list(sess.tokens)
             remaining = max(1, sess.req.max_new - len(sess.tokens))
             best_aff, aff_len = None, 0
-            for i in healthy:
+            for i in cand:
                 m = self.replicas[i].prefix_match_len(cont)
                 if m > aff_len:
                     best_aff, aff_len = i, m
-            least = min(healthy, key=lambda i: (load[i] * scale[i], i))
+            least = min(cand, key=lambda i: (load[i] * scale[i], i))
             target = least
             if (best_aff is not None
                     and aff_len >= self.affinity_min_tokens
@@ -412,8 +438,14 @@ class ServeRouter:
                          - (aff_len if target == best_aff else 0))
             # load_estimate, not _rounded_need: a speculating replica's
             # decode cost is verify dispatches (k+1 ticks each) scaled
-            # by its measured acceptance rate, not segment-rounded ticks
-            load[target] += suffix + rep.load_estimate(remaining)
+            # by its measured acceptance rate, not segment-rounded ticks.
+            # prefill_cost, not raw suffix length: a chunking replica
+            # pays ceil(suffix/chunk) admission waves, not one wave per
+            # token — raw tokens would systematically overprice
+            # long-prompt placements there (unchunked returns suffix
+            # unchanged)
+            load[target] += rep.prefill_cost(suffix) \
+                + rep.load_estimate(remaining)
             out.setdefault(target, []).append(j)
             self.routed_per_replica[target] += 1
         return out
@@ -466,7 +498,13 @@ class ServeRouter:
             sessions.append(_Session(
                 req=r, arrive_abs=t0 + getattr(r, "arrival_s", 0.0),
                 deadline_at=(t0 + r.deadline_s
-                             if r.deadline_s is not None else None)))
+                             if r.deadline_s is not None else None),
+                # single-token prompts have nothing to prefill; a
+                # max_new=1 request finishes inside its prefill hop
+                # anyway, so skipping the tier saves it a migration
+                phase=("prefill" if self._prefill_set
+                       and len(r.tokens) > 1 and r.max_new > 1
+                       else "decode")))
         results: list[RequestResult | None] = [None] * n
         self.stats["routed"] += n
 
@@ -552,10 +590,20 @@ class ServeRouter:
         outs: dict[int, list] = {}
         errs: dict[int, BaseException] = {}
         threads: dict[int, threading.Thread] = {}
+        hops: dict[int, set[int]] = {}
         round_start = now
         for i, idxs in placement.items():
-            subs = [self._sub_request(sessions[j], self.replicas[i], now)
-                    for j in idxs]
+            subs = []
+            for j in idxs:
+                sub = self._sub_request(sessions[j], self.replicas[i], now)
+                if i in self._prefill_set \
+                        and sessions[j].phase == "prefill":
+                    # prefill-tier placement: run the prompt's prefill
+                    # plus ONE decode tick (the token TTFT measures),
+                    # then hop the session to the decode tier
+                    sub = replace(sub, max_new=1)
+                    hops.setdefault(i, set()).add(j)
+                subs.append(sub)
             for j in idxs:
                 sessions[j].rounds += 1
 
@@ -615,6 +663,7 @@ class ServeRouter:
                                 shed_for, next_pending)
                 continue
             res = outs.get(i, [])
+            hop = hops.get(i, set())
             faulted: list[tuple[int, RequestResult]] = []
             for j, r in zip(idxs, res):
                 if (r.status == FAILED and r.error
@@ -626,6 +675,24 @@ class ServeRouter:
                     sess.queue_wait_s = slo_base + r.queue_wait_s
                 if sess.ttft_s is None and r.ttft_s is not None:
                     sess.ttft_s = slo_base + r.ttft_s
+                eos = self.replicas[i].eos_id
+                if (j in hop and r.status == OK
+                        and len(sess.tokens) + len(r.tokens)
+                        < sess.req.max_new
+                        and not (eos is not None and r.tokens
+                                 and r.tokens[-1] == eos)):
+                    # prompt prefilled, first token out, budget left:
+                    # hop to the decode tier carrying the finished KV
+                    # blocks (a planned move — not a migration)
+                    sess.tokens.extend(r.tokens)
+                    sess.ticks += r.ticks
+                    sess.recoveries += r.recoveries
+                    sess.cached_prefix += r.cached_prefix_tokens
+                    sess.phase = "decode"
+                    self.stats["prefill_hops"] += 1
+                    self._handoff(i, sess)
+                    next_pending.append(j)
+                    continue
                 finalize(j, i, r, now)
             if faulted:
                 self._fail_over(i, [j for j, _ in faulted],
@@ -674,3 +741,37 @@ class ServeRouter:
         flight.dump_on_fault("replica_failover", fault=why, replica=i,
                              migrated=migrated,
                              breaker=self._breakers[i].state)
+
+    def _handoff(self, i: int, sess: _Session) -> None:
+        """Move prefill replica ``i``'s finished KV blocks for this
+        session to a decode replica: export the prompt-prefix entry
+        from ``i``'s radix/tier (D2H or straight from its spill tier),
+        import it into the warmest — then least-routed — healthy decode
+        replica, whose own radix now holds the prefix so the next
+        round's affinity probe routes the continuation there. Any miss
+        (no exportable entry, CRC/shape decline, pool pressure) is a
+        fallback, not an error: the decode replica simply re-prefills
+        the token-identical continuation (replay)."""
+        cont = list(sess.req.tokens) + list(sess.tokens)
+        targets = [t for t in self.healthy_replicas()
+                   if t not in self._prefill_set]
+        ok, target = False, None
+        if targets:
+            target = max(targets, key=lambda t: (
+                self.replicas[t].prefix_match_len(cont),
+                -self.routed_per_replica[t], -t))
+            try:
+                payload = self.replicas[i].export_prefix(cont)
+                ok = self.replicas[target].import_prefix(payload)
+            except Exception:  # noqa: BLE001 — handoff is best-effort
+                ok = False
+        if ok:
+            self.stats["handoffs"] += 1
+            flight.record("prefill_handoff", src=i, dst=target,
+                          n_tokens=len(cont) - 1)
+        else:
+            self.stats["handoff_fallbacks"] += 1
+            instant("prefill_handoff_fallback", src=i, dst=target,
+                    n_tokens=len(cont) - 1)
+            flight.record("prefill_handoff_fallback", src=i, dst=target,
+                          n_tokens=len(cont) - 1)

@@ -299,6 +299,7 @@ def test_disk_crc_corruption_degrades_to_miss(gpt2, tmp_path):
     # eviction is lazy, so push the demoted head (A) to disk explicitly
     # rather than growing the stream until host pressure does it
     on._tier._spill_one()
+    on._tier.disk.drain()  # async writer: part must be on disk to corrupt
     parts = glob.glob(str(tmp_path / "*.npz"))
     assert parts and [e for e in on._radix.entries
                       if e.tier == TIER_DISK]
