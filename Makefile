@@ -26,7 +26,7 @@ SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
 	serve-tier-smoke serve-spec-smoke serve-load-smoke \
-	serve-router-smoke serve-disagg-smoke bench-diff
+	serve-router-smoke serve-disagg-smoke serve-journal-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -99,6 +99,12 @@ bench:
 #   unchunked/unified references, at least one handoff moves KV blocks
 #   instead of replaying tokens, and nothing leaks a slot or block;
 #   records TTFT p99 unified vs split (the hardware A/B)
+# - serve-journal: the crash-durability drill — a journaling serve
+#   subprocess SIGKILLed mid-stream (fsync=os), restarted, recovered
+#   from the write-ahead session journal; fails unless the restarted
+#   run's tokens are identical to an unkilled reference, >= 1 session
+#   resumed from journaled state, nothing leaks, and the journal-on
+#   decode-tick p99 stays within 1.25x of journal-off (best of 3)
 # - bench-diff (last): the regression gate's self-test — one smoke's
 #   record diffed against itself through obs/regress.py must pass
 #   (a gate that flags identical runs is broken)
@@ -113,6 +119,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-journal-smoke
 	$(MAKE) bench-diff
 
 # the bench-regression gate (obs/regress.py): BASE/NEW default to a
@@ -148,3 +155,6 @@ serve-router-smoke:
 
 serve-disagg-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke
+
+serve-journal-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-journal-smoke

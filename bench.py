@@ -2456,6 +2456,208 @@ def serve_disagg_smoke():
     return 0
 
 
+# the crash-durability driver run in REAL subprocesses by
+# serve_journal_smoke: a Poisson stream through a journaling batcher.
+# argv = [journal_dir ('' = journal off), out_json]. Deterministic
+# (fixed init key + LoadSpec seed) so three processes — reference,
+# killed, restarted — build the identical workload.
+_JOURNAL_DRIVER = r"""
+import dataclasses, json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+from distributed_compute_pytorch_tpu.utils.compilation_cache import (
+    enable as enable_compile_cache)
+enable_compile_cache(os.environ["DCP_COMPILE_CACHE"])
+from distributed_compute_pytorch_tpu import serve_journal as sj
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.obs.loadgen import (
+    LoadSpec, offered_load)
+from distributed_compute_pytorch_tpu.serve import ContinuousBatcher
+
+jd, out = sys.argv[1], sys.argv[2]
+model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+params, _ = model.init(jax.random.key(0))
+reqs = offered_load(LoadSpec(n_requests=24, rate_rps=50.0, seed=11,
+                             prompt_len=(2, 8), max_new=(32, 64)))
+for i, r in enumerate(reqs):
+    r.request_id = f"req-{i:03d}"
+    if i % 4 == 3:                    # sampled rows ride along: their
+        r.temperature = 0.8           # materialized seeds are journaled
+recovery, kw = None, {}
+if jd:
+    recovery = sj.recover(jd)
+    kw = dict(journal_dir=jd, journal_fsync="os")
+cb = ContinuousBatcher(model, params, slots=4, t_max=128, prompt_buf=10,
+                       segment=4, **kw)
+res = cb.serve_detailed(reqs, recovery=recovery)
+with open(out, "w") as f:
+    json.dump({"ids": [r.request_id for r in res],
+               "status": [r.status for r in res],
+               "tokens": [r.tokens for r in res],
+               "recovered": int(cb.journal["recovered_sessions"]),
+               "deduped": int(cb.journal["deduped_completions"]),
+               "leaks": cb.last_slot_leaks + cb.last_block_leaks
+                        + cb.last_host_block_leaks}, f)
+"""
+
+
+def serve_journal_smoke():
+    """Crash-durability drill for the write-ahead session journal
+    (`make serve-journal-smoke`, wired into `make bench-smoke`).
+
+    Stage 1 — the drill the journal exists for, with a REAL SIGKILL:
+    a Poisson stream serves in a journaling subprocess (fsync=os — the
+    survives-process-death tier); the parent waits until the WAL shows
+    harvested deltas, then SIGKILLs it mid-stream. A restarted process
+    recovers from the journal and must finish every request with
+    token streams IDENTICAL to an unkilled reference process, at least
+    one session resuming from journaled state, and zero leaks.
+
+    Stage 2 — the price: decode-tick p99 (harvest-span gaps from the
+    tracer, the serve_disagg technique) with the journal ON (fsync=os)
+    must stay within 1.25x of journal OFF, best-of-3 trials (the os
+    policy buys SIGKILL durability for buffered appends only — it must
+    not cost a visible slice of the tick)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import dataclasses
+    import signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    from distributed_compute_pytorch_tpu.models.gpt2 import (
+        GPT2, GPT2Config)
+    from distributed_compute_pytorch_tpu.obs.tracing import (
+        Tracer, configure_tracer)
+    from distributed_compute_pytorch_tpu.serve import (
+        ContinuousBatcher, Request)
+
+    work = tempfile.mkdtemp(prefix="dcp_journal_smoke_")
+    driver = os.path.join(work, "driver.py")
+    with open(driver, "w") as f:
+        f.write(_JOURNAL_DRIVER)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["DCP_COMPILE_CACHE"] = env.get(
+        "DCP_COMPILE_CACHE",
+        os.path.join(tempfile.gettempdir(), "dcp_jax_cache"))
+    # the driver lives in a tempdir: put this repo on its import path
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(jd, out):
+        return subprocess.run([sys.executable, driver, jd, out],
+                              env=env, timeout=600)
+
+    # unkilled reference (also warms the shared compile cache, so the
+    # killed run spends its life SERVING, not compiling)
+    ref_out = os.path.join(work, "ref.json")
+    assert run("", ref_out).returncode == 0
+    with open(ref_out) as f:
+        ref = json.load(f)
+
+    # the kill run: SIGKILL once the journal shows harvest deltas
+    jd = os.path.join(work, "wal")
+    wal = os.path.join(jd, "serve.wal")
+    proc = subprocess.Popen([sys.executable, driver, jd,
+                             os.path.join(work, "never.json")], env=env)
+    deadline = time.time() + 300
+    killed = False
+    while time.time() < deadline and proc.poll() is None:
+        try:
+            with open(wal, "rb") as f:
+                seen_delta = b'"kind":"delta"' in f.read()
+        except OSError:
+            seen_delta = False
+        if seen_delta:
+            proc.send_signal(signal.SIGKILL)
+            killed = True
+            break
+        time.sleep(0.03)
+    proc.wait(timeout=60)
+    kill_rc = proc.returncode
+
+    # the restarted process: recover + finish
+    res_out = os.path.join(work, "restart.json")
+    restart_rc = run(jd, res_out).returncode
+    with open(res_out) as f:
+        res = json.load(f)
+
+    # ---- stage 2: decode-tick p99, journal on vs off ----
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    batch = [Request([int(t) for t in rng.integers(1, 256, 6)], 32)
+             for _ in range(12)]
+
+    def clone():
+        return [dataclasses.replace(r) for r in batch]
+
+    def traced_p99(cb):
+        tracer = Tracer()
+        prev = configure_tracer(tracer)
+        try:
+            out = cb.serve_detailed(clone())
+        finally:
+            configure_tracer(prev)
+        path = os.path.join(work, "trace.json")
+        tracer.dump(path)
+        tracer.close()
+        with open(path) as f:
+            events = json.load(f)["traceEvents"]
+        ends = sorted(e["ts"] for e in events
+                      if e.get("name") == "harvest"
+                      and e.get("ph") == "E")
+        gaps = [(b - a) / 1e6 for a, b in zip(ends, ends[1:])]
+        return out, float(np.percentile(gaps, 99))
+
+    warm = ContinuousBatcher(model, params, slots=4, t_max=64,
+                             prompt_buf=8, segment=4)
+    warm.serve_detailed(clone())      # compile outside the timed trials
+    ratios, p99s = [], []
+    for trial in range(3):
+        cb_off = ContinuousBatcher(model, params, slots=4, t_max=64,
+                                   prompt_buf=8, segment=4)
+        off_res, p99_off = traced_p99(cb_off)
+        cb_on = ContinuousBatcher(
+            model, params, slots=4, t_max=64, prompt_buf=8, segment=4,
+            journal_dir=os.path.join(work, f"twal{trial}"),
+            journal_fsync="os")
+        on_res, p99_on = traced_p99(cb_on)
+        assert [r.tokens for r in on_res] == [r.tokens for r in off_res]
+        ratios.append(p99_on / p99_off)
+        p99s.append((p99_off, p99_on))
+    best_ratio = min(ratios)
+
+    ref_by_id = dict(zip(ref["ids"], ref["tokens"]))
+    checks = {
+        "reference_all_ok": all(s == "ok" for s in ref["status"]),
+        "kill_landed_mid_stream": killed and kill_rc != 0,
+        "restart_completed": restart_rc == 0
+            and all(s == "ok" for s in res["status"]),
+        "token_parity_through_sigkill":
+            {i: t for i, t in zip(res["ids"], res["tokens"])} == ref_by_id,
+        "recovered_from_journal": res["recovered"] >= 1,
+        "zero_leaks": res["leaks"] == 0,
+        "tick_p99_overhead_bounded": best_ratio <= 1.25,
+    }
+    _print_record({
+        "metric": "serve_journal_smoke",
+        "requests": len(ref["ids"]),
+        "kill_rc": kill_rc,
+        "recovered_sessions": res["recovered"],
+        "deduped_completions": res["deduped"],
+        "tick_p99_s": [{"off": round(a, 5), "on": round(b, 5)}
+                       for a, b in p99s],
+        "tick_p99_ratio_best_of_3": round(best_ratio, 3),
+        "checks": checks})
+    bad = [k for k, ok in checks.items() if not ok]
+    if bad:
+        raise SystemExit(f"serve journal smoke failed: {bad}")
+    return 0
+
+
 def _max_spread(rec):
     """Deepest ``spread`` field in a (nested) stage record, or None."""
     if not isinstance(rec, dict):
@@ -2495,6 +2697,8 @@ def main():
         return serve_router_smoke()
     if "--serve-disagg-smoke" in sys.argv:
         return serve_disagg_smoke()
+    if "--serve-journal-smoke" in sys.argv:
+        return serve_journal_smoke()
     if "--grad-accum-smoke" in sys.argv:
         return grad_accum_smoke()
     import tempfile

@@ -24,10 +24,25 @@ many prompt tokens were served from cache (``"cached_prefix"``).
 JSON requests may also carry per-request sampling settings
 (``"temperature"``, ``"top_k"``, ``"top_p"``, ``"seed"``), overriding
 the CLI defaults — requests with different settings decode side by
-side in the same compiled segment — and a per-request wall-clock
-``"deadline"`` (seconds). Prints one JSON line per request, in input
-order: {"prompt": [...], "new": [...], "status": "ok"} (+ "text" when
-a tokenizer is given; + "error" for non-ok outcomes).
+side in the same compiled segment — a per-request wall-clock
+``"deadline"`` (seconds), and a stable ``"id"`` (default:
+``req-{line}``) that names the session in the journal and on its
+output line. Prints one JSON line per request, in input order:
+{"id": ..., "prompt": [...], "new": [...], "status": "ok"} (+ "text"
+when a tokenizer is given; + "error" for non-ok outcomes).
+
+CRASH DURABILITY (``serve_journal.py``): ``--journal_dir DIR`` keeps
+an append-only CRC-framed write-ahead log of every admission, every
+harvested token batch, and every terminal status (``--journal_fsync``
+prices durability: every_frame | every_harvest | os). A killed
+process restarted with the same ``--journal_dir`` and request file
+dedups journal-completed requests (recorded stream, zero device
+work) and resumes incomplete sessions token-identically from their
+prompt + emitted-so-far. ``--supervise N`` runs serving under an
+in-process supervisor: the serve loop runs as a subprocess and is
+respawned (with ``elastic.backoff_delays`` backoff, at most N times)
+whenever it dies abnormally — SIGKILL/OOM/crash — while clean exits
+(0, 1, and 75/preempted) pass through.
 
 Serving is FAULT-TOLERANT per request (``serve.serve_detailed``): a
 request fails, times out (``--request_deadline`` default /
@@ -93,9 +108,13 @@ def _read_requests(path: str, tok, default_new: int, defaults: dict):
                 raise SystemExit(f"requests line {i + 1}: max_new must "
                                  f"be a positive integer, got {new!r}")
             for k in ("temperature", "top_k", "top_p", "seed",
-                      "deadline"):
+                      "deadline", "id"):
                 if k in obj:
                     sampling[k] = obj[k]
+            if sampling.get("id") is not None \
+                    and not isinstance(sampling["id"], str):
+                raise SystemExit(f"requests line {i + 1}: 'id' must be "
+                                 f"a string, got {sampling['id']!r}")
             if sampling["temperature"] == 0.0 and (
                     sampling["top_k"] is not None
                     or sampling["top_p"] is not None):
@@ -123,6 +142,54 @@ def _read_requests(path: str, tok, default_new: int, defaults: dict):
     if not out:
         raise SystemExit("no requests")
     return out
+
+
+def _strip_supervise(argv: list[str]) -> list[str]:
+    """The child command line: everything the supervisor got, minus
+    the --supervise flag itself (a supervised child must not recurse
+    into another supervisor)."""
+    out = []
+    it = iter(argv)
+    for a in it:
+        if a == "--supervise":
+            next(it, None)
+            continue
+        if a.startswith("--supervise="):
+            continue
+        out.append(a)
+    return out
+
+
+def _supervise(budget: int, argv) -> int:
+    """The restart loop: run the serve CLI as a subprocess; respawn on
+    abnormal death (a signal, or an exit code outside the CLI's
+    contract) with exponential backoff, at most ``budget`` times.
+    Clean exits pass through: 0 (all ok), 1 (some requests non-ok — a
+    deterministic outcome a restart would only repeat), and 75
+    (EXIT_PREEMPTED: the drain protocol already ran)."""
+    import subprocess
+    from distributed_compute_pytorch_tpu.train.elastic import (
+        EXIT_PREEMPTED, backoff_delays)
+    child = _strip_supervise(list(sys.argv[1:] if argv is None else argv))
+    cmd = [sys.executable, "-m",
+           "distributed_compute_pytorch_tpu.cli_serve", *child]
+    delays = backoff_delays(max(1, budget), 1.0)
+    restarts = 0
+    while True:
+        rc = subprocess.call(cmd)
+        if rc in (0, 1, EXIT_PREEMPTED):
+            return rc
+        if restarts >= budget:
+            print(f"dcp-serve supervisor: restart budget ({budget}) "
+                  f"exhausted; giving up (last rc {rc})",
+                  file=sys.stderr, flush=True)
+            return rc if rc > 0 else 1
+        delay = delays[min(restarts, len(delays) - 1)]
+        restarts += 1
+        print(f"dcp-serve supervisor: serve process died (rc {rc}); "
+              f"restart {restarts}/{budget} in {delay:.2f}s",
+              file=sys.stderr, flush=True)
+        time.sleep(delay)
 
 
 def main(argv=None) -> int:
@@ -247,6 +314,32 @@ def main(argv=None) -> int:
                    help="admission order: strict FIFO (fairness: no "
                         "request is leapfrogged) or skip-fit (a free row "
                         "takes the first queued request that fits)")
+    # --- crash durability (serve_journal.py; module docstring) ---
+    p.add_argument("--journal_dir", type=str, default=None,
+                   help="crash-durable serving: append-only CRC-framed "
+                        "write-ahead session journal in this directory. "
+                        "Admissions are logged before any device work, "
+                        "harvested tokens per segment, terminal status "
+                        "at completion; restarting with the same dir "
+                        "and request file dedups completed requests "
+                        "and resumes incomplete sessions token-"
+                        "identically (greedy AND sampled)")
+    p.add_argument("--journal_fsync", default="every_harvest",
+                   choices=("every_frame", "every_harvest", "os"),
+                   help="journal durability price: fsync per frame "
+                        "(power-loss safe, slowest), per harvest "
+                        "boundary (default), or never — flush to the "
+                        "OS page cache only, which still survives any "
+                        "process death (SIGKILL/OOM), just not power "
+                        "loss")
+    p.add_argument("--supervise", type=int, default=0,
+                   help="run the serve loop as a supervised subprocess: "
+                        "respawn it (exponential backoff via "
+                        "elastic.backoff_delays) when it dies "
+                        "abnormally, at most N restarts; clean exits "
+                        "(0, 1, 75/preempted) pass through. Requires "
+                        "--journal_dir so restarts recover sessions "
+                        "instead of redoing them. 0 (default) = off")
     # --- fault tolerance (serve_detailed; module docstring) ---
     p.add_argument("--max_pending", type=int, default=None,
                    help="bounded admission: accept at most slots + N "
@@ -356,6 +449,18 @@ def main(argv=None) -> int:
             raise SystemExit("--prefill_replicas hands finished KV "
                              "blocks over through the radix cache: it "
                              "requires --prefix_cache")
+    if args.supervise < 0:
+        raise SystemExit("--supervise must be >= 0")
+    if args.supervise and args.journal_dir is None:
+        raise SystemExit("--supervise without --journal_dir would redo "
+                         "completed work on every restart; give the "
+                         "supervisor a journal to recover from")
+    if args.supervise:
+        # supervisor mode: the actual serving (heavy imports, compile,
+        # checkpoint load) happens in a child process this parent
+        # respawns on abnormal death — before any signal handlers or
+        # device state exist in the parent
+        return _supervise(args.supervise, argv)
     # SIGTERM/SIGINT -> graceful drain, armed BEFORE the heavy imports /
     # checkpoint load / compiles so a preemption at ANY point of startup
     # drains instead of dying mid-load (the trainer's PreemptionGuard,
@@ -386,9 +491,21 @@ def main(argv=None) -> int:
             args.eos_id = tok.eos_id
     defaults = {"temperature": args.temperature, "top_k": args.top_k,
                 "top_p": args.top_p, "seed": None,
-                "deadline": args.request_deadline}
+                "deadline": args.request_deadline, "id": None}
     reqs = _read_requests(args.requests, tok, args.max_new_tokens,
                           defaults)
+    # stable session identities: explicit JSON "id" wins, otherwise the
+    # line position — DETERMINISTIC across restarts, which is what lets
+    # a rerun of the same request file dedup against the journal
+    seen_ids: set[str] = set()
+    for i, r in enumerate(reqs):
+        rid = r["id"] if r["id"] is not None else f"req-{i:05d}"
+        if rid in seen_ids:
+            raise SystemExit(f"duplicate request id {rid!r}: journal "
+                             f"recovery dedups by id, so ids must be "
+                             f"unique per run")
+        seen_ids.add(rid)
+        r["id"] = rid
 
     vocab = model.config.vocab_size
     bad = [t for r in reqs for t in r["tokens"] if not 0 <= t < vocab]
@@ -438,6 +555,26 @@ def main(argv=None) -> int:
             metrics_f.write(line + "\n")
             metrics_f.flush()
 
+    # crash durability: recover FIRST (the manifest is what the previous
+    # process managed to make durable), then open the writer — both
+    # repair a torn tail, so either finds a clean log. One shared writer
+    # for every replica: frames interleave, recovery keys by id.
+    recovery = None
+    journal = None
+    if args.journal_dir:
+        from distributed_compute_pytorch_tpu import serve_journal
+        recovery = serve_journal.recover(args.journal_dir)
+        if recovery.sessions:
+            print(json.dumps({
+                "kind": "serve_recovery", "ts": time.time(),
+                "sessions": len(recovery.sessions),
+                "completed": len(recovery.completed),
+                "incomplete": len(recovery.incomplete),
+                "torn_bytes": recovery.torn_bytes}),
+                file=sys.stderr, flush=True)
+        journal = serve_journal.ServeJournal(args.journal_dir,
+                                             fsync=args.journal_fsync)
+
     def build_batcher(replica=None):
         hb_cb = None
         if args.heartbeat:
@@ -462,7 +599,8 @@ def main(argv=None) -> int:
             heartbeat_s=args.heartbeat or None,
             on_heartbeat=hb_cb,
             speculate=args.speculate or None,
-            prefill_chunk_tokens=args.prefill_chunk_tokens)
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
+            journal=journal)
 
     router = None
     if args.replicas > 1:
@@ -508,18 +646,21 @@ def main(argv=None) -> int:
                                     temperature=r["temperature"],
                                     top_k=r["top_k"],
                                     top_p=r["top_p"], seed=req_seed(i, r),
-                                    deadline_s=r["deadline"])
+                                    deadline_s=r["deadline"],
+                                    request_id=r["id"])
                             for i, r in enumerate(reqs)]
                 if router is not None:
                     results = router.route(
                         requests, drain=guard,
                         drain_deadline_s=args.drain_deadline,
                         chaos=({args.fault_replica: chaos}
-                               if chaos is not None else None))
+                               if chaos is not None else None),
+                        recovery=recovery)
                 else:
                     results = cb.serve_detailed(
                         requests, drain=guard,
-                        drain_deadline_s=args.drain_deadline, chaos=chaos)
+                        drain_deadline_s=args.drain_deadline, chaos=chaos,
+                        recovery=recovery)
             finally:
                 guard.__exit__()
     finally:
@@ -531,12 +672,14 @@ def main(argv=None) -> int:
                                         "ts": time.time(),
                                         **snap}) + "\n")
             metrics_f.close()
+        if journal is not None:
+            journal.close()
         if tracer is not None:
             configure_tracer(None)
             tracer.dump(args.trace_path)
             tracer.close()
     for r, res in zip(reqs, results):
-        rec = {"prompt": r["tokens"], "new": res.tokens,
+        rec = {"id": r["id"], "prompt": r["tokens"], "new": res.tokens,
                "status": res.status,
                "cached_prefix": res.cached_prefix_tokens}
         if router is not None:

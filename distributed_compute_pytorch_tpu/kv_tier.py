@@ -69,7 +69,7 @@ from distributed_compute_pytorch_tpu.kv_pool import (
 TIER_STATS = {
     "demotions": 0, "promotions": 0,
     "host_hits": 0, "disk_hits": 0,
-    "disk_spills": 0, "disk_crc_miss": 0,
+    "disk_spills": 0, "disk_crc_miss": 0, "disk_adopted": 0,
     "bytes_d2h": 0, "bytes_h2d": 0,
     "promote_overlap_ms": 0.0,
     "host_pool_occupancy": 0.0,
@@ -175,6 +175,52 @@ class DiskTier:
         self._pending: dict[str, np.ndarray] = {}
         self._q: queue.Queue = queue.Queue()
         self._writer: threading.Thread | None = None
+        self._scan_on_open()
+
+    def _scan_on_open(self) -> None:
+        """Rebuild the index from the JSON sidecars already in the
+        directory, so a restarted process can find the previous one's
+        spilled shards (pre-journal the index was in-memory only: the
+        bytes survived, nothing could reach them). A sidecar that
+        fails to parse or disagrees with its filename skips that entry
+        — the part degrades to a miss, the tier never fails to open.
+        One CRC spot-check (the lowest-numbered part) catches a
+        systematically corrupt directory cheaply; per-entry CRCs still
+        verify lazily on every ``get``."""
+        found: dict[str, dict] = {}
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("part-") and name.endswith(".json")):
+                continue
+            key = name[:-len(".json")]
+            try:
+                seq = int(key.split("-", 1)[1])
+            except ValueError:
+                continue
+            # never reuse a seen sequence number, even for a part we
+            # end up skipping — a fresh put must not collide with it
+            self._seq = max(self._seq, seq + 1)
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    rec = json.load(f)
+            except Exception:
+                continue
+            if (not isinstance(rec, dict) or rec.get("key") != key
+                    or not isinstance(rec.get("crc"), int)
+                    or not isinstance(rec.get("shape"), list)
+                    or not isinstance(rec.get("dtype"), str)
+                    or not os.path.exists(
+                        os.path.join(self.root, key + ".npz"))):
+                continue
+            found[key] = rec
+        if found:
+            spot = min(found)
+            self.index = found
+            if self.get(spot)[0] is None:
+                self.index.pop(spot, None)
 
     def _write_part(self, key: str, content: np.ndarray,
                     rec: dict) -> None:
@@ -208,11 +254,12 @@ class DiskTier:
             finally:
                 self._q.task_done()
 
-    def put(self, content: np.ndarray) -> str:
+    def put(self, content: np.ndarray, tokens=()) -> str:
         key = f"part-{self._seq:05d}"
         self._seq += 1
         rec = {"key": key, "crc": _crc(content),
-               "shape": list(content.shape), "dtype": str(content.dtype)}
+               "shape": list(content.shape), "dtype": str(content.dtype),
+               "tokens": [int(t) for t in tokens]}
         if not self.async_writes:
             self._write_part(key, content, rec)
             self.index[key] = rec
@@ -271,9 +318,23 @@ class DiskTier:
             self._q.join()
 
     def reset(self) -> None:
+        """Drop every indexed part AND sweep stray ``part-*`` files the
+        index never adopted (a previous process's corrupt or torn
+        shards) — tests sharing a directory must start clean."""
         self.drain()
         for key in list(self.index):
             self.drop(key)
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith("part-") and (name.endswith(".npz")
+                                             or name.endswith(".json")):
+                try:
+                    os.remove(os.path.join(self.root, name))
+                except OSError:
+                    pass
 
 
 class KVTierManager:
@@ -333,7 +394,8 @@ class KVTierManager:
         victim = min(hosted, key=lambda e: e.last_used)
         if self.disk is not None:
             content = self.host.read(victim.host_blocks)
-            victim.disk_key = self.disk.put(content)
+            victim.disk_key = self.disk.put(
+                content, tokens=getattr(victim, "tokens", ()) or ())
             self.host.release(victim.host_blocks)
             victim.host_blocks = []
             victim.tier = TIER_DISK
@@ -381,6 +443,42 @@ class KVTierManager:
             self.stats["bytes_h2d"] += int(content.nbytes)
             return content
         raise AssertionError(f"fetch on resident entry {entry.tier}")
+
+    # ---- restart adoption (disk -> radix) ------------------------------
+
+    def adopt_disk_index(self, expect) -> int:
+        """Warm the radix tree from a restarted :class:`DiskTier`'s
+        rebuilt index: every shard whose sidecar carries its prefix
+        tokens re-enters the tree as a TIER_DISK entry, so the first
+        request sharing that prefix promotes it instead of paying cold
+        prefill. ``expect(n_tokens) -> (shape, dtype_str)`` is the
+        adopting engine's geometry — a shard written under a different
+        model config, block size, or dtype is skipped (adopting it
+        would feed the compiled promote a mis-shaped array), as is any
+        prefix already resident. Returns the number of entries
+        adopted."""
+        if self.disk is None:
+            return 0
+        adopted = 0
+        for key in sorted(self.disk.index):
+            rec = self.disk.index[key]
+            toks = rec.get("tokens") or []
+            if not toks:
+                continue             # pre-journal shard: no identity
+            shape, dtype = expect(len(toks))
+            if (list(rec.get("shape", [])) != list(shape)
+                    or rec.get("dtype") != str(dtype)):
+                continue
+            entry = self.radix.insert_demoted([int(t) for t in toks])
+            if entry is None:        # prefix already in the tree
+                continue
+            entry.tier = TIER_DISK
+            entry.host_blocks = []
+            entry.disk_key = key
+            self._demoted.append(entry)
+            adopted += 1
+        self.stats["disk_adopted"] += adopted
+        return adopted
 
     # ---- drops / lifecycle ---------------------------------------------
 

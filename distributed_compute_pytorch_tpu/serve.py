@@ -166,7 +166,7 @@ import threading
 import time
 import warnings
 import weakref
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 import jax
 import jax.numpy as jnp
@@ -188,6 +188,7 @@ from distributed_compute_pytorch_tpu.obs import flight
 from distributed_compute_pytorch_tpu.obs import metrics as obs_metrics
 from distributed_compute_pytorch_tpu.obs.metrics import device_memory_gauges
 from distributed_compute_pytorch_tpu.obs.tracing import instant, span
+from distributed_compute_pytorch_tpu.serve_journal import JOURNAL_STATS
 from distributed_compute_pytorch_tpu.serve_lifecycle import (
     CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
 from distributed_compute_pytorch_tpu.train.elastic import call_with_timeout
@@ -235,6 +236,11 @@ class Request:
     seed: int | None = None
     deadline_s: float | None = None
     arrival_s: float = 0.0
+    # stable identity for journal recovery (ISSUE 15): dedup and
+    # replay key on it across process restarts. ``None`` defaults to
+    # the request's position in the serve call (``req-{i}``) — fine
+    # inside one call, but resubmitters that reorder must set it.
+    request_id: str | None = None
 
 
 @dataclass
@@ -389,7 +395,10 @@ class ContinuousBatcher:
                  prefill_chunk_tokens: int | None = None,
                  heartbeat_s: float | None = None,
                  on_heartbeat=None,
-                 speculate=None):
+                 speculate=None,
+                 journal=None,
+                 journal_dir: str | None = None,
+                 journal_fsync: str = "every_harvest"):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -640,8 +649,32 @@ class ContinuousBatcher:
         # resumes
         self._cur_h = np.zeros((slots,), np.int32)
         self._nlog_h = np.zeros((slots,), np.int32)
+        # crash-durable serving (serve_journal.py): the write-ahead
+        # session log. A shared writer instance (a router fleet logging
+        # into one journal) wins over journal_dir; either way the
+        # journal's counter dict is rebound to the serve.journal.*
+        # MetricDict in _zero_stats so gauges and dict agree.
+        if journal is None and journal_dir is not None:
+            from distributed_compute_pytorch_tpu.serve_journal import (
+                ServeJournal)
+            journal = ServeJournal(journal_dir, fsync=journal_fsync)
+        self._journal = journal
+        # recovery-replay admission metadata, set by _run_recovered for
+        # the duration of one inner _run: sub-request index -> (request
+        # id, original prompt, tokens already emitted) so the admit
+        # frame records the TRUE session, not the continuation shape
+        self._replay_admits: dict = {}
         self.ticks = 0             # decode ticks run this session
         self._zero_stats()
+        # a restarted disk tier re-enters the radix: shards whose
+        # sidecars carry prefix tokens AND match this engine's cache
+        # geometry become TIER_DISK entries — the warm-restart half of
+        # crash durability (cold prefill only for what disk lost)
+        if self._tier is not None and self._tier.disk is not None:
+            np_dtype = np.dtype(dtype)
+            self._tier.adopt_disk_index(
+                lambda n: ((n_layers, 2, -(-n // self.bt), hk, self.bt,
+                            hd), str(np_dtype)))
         # moe_capacity is STATIC: capacity shapes the routing one-hots, so
         # each distinct (wave size, wave-max capacity) pair compiles its
         # own admission program; per-row capacities ride along as a
@@ -764,6 +797,21 @@ class ContinuousBatcher:
             "chunk_tokens": 0, "stall_ticks": 0,
             "handoff_exports": 0, "handoff_imports": 0,
             "handoff_declined": 0, "handoff_bytes": 0})
+        # write-ahead-journal attribution (ISSUE 15): frames/bytes
+        # appended, fsyncs paid (the durability price), torn tails
+        # repaired on open, and the recovery ledger — sessions replayed,
+        # completions deduped, tokens re-admitted as replay prompt. The
+        # journal WRITER outlives serve sessions (it is process-scoped
+        # state, like the log file itself), so its counters CARRY OVER
+        # a reset instead of zeroing, then the writer is rebound to the
+        # MetricDict so dict and gauges can never disagree.
+        _jr = getattr(self, "_journal", None)
+        self.journal = obs_metrics.MetricDict(
+            self.obs, "serve.journal.",
+            {**dict(JOURNAL_STATS),
+             **({} if _jr is None else dict(_jr.stats))})
+        if _jr is not None:
+            _jr.stats = self.journal
         self.last_host_block_leaks = 0  # host blocks unaccounted at exit
         # per-request SLO distributions (serve_lifecycle.RequestResult
         # field docs define the measurement points); seconds, log
@@ -786,6 +834,7 @@ class ContinuousBatcher:
             "spec": dict(self.spec),
             "tier": dict(self.tier),
             "prefill": dict(self.prefill),
+            "journal": dict(self.journal),
             "slo": {name: h.summary() for name, h in self._slo.items()},
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
@@ -1431,7 +1480,7 @@ class ContinuousBatcher:
 
     def serve_detailed(self, requests: list[Request], *, drain=None,
                        drain_deadline_s: float | None = None,
-                       chaos=None) -> list:
+                       chaos=None, recovery=None) -> list:
         """Fault-tolerant serving: run every request through the pool
         and return a :class:`serve_lifecycle.RequestResult` PER REQUEST
         (in request order) — nothing raises away the call, and no
@@ -1464,9 +1513,107 @@ class ContinuousBatcher:
         when a fault survives reconstruction. ``chaos`` injects faults
         for drills (:class:`serve_lifecycle.ChaosInjector`); production
         passes None.
+
+        ``recovery`` — a ``serve_journal.RecoveryManifest`` (from
+        ``serve_journal.recover(dir)``) built from a PREVIOUS process's
+        journal: requests the journal shows completed return their
+        recorded stream with zero device work (dedup by request id),
+        and incomplete sessions re-enter admission as
+        prompt+emitted-so-far replays, token-identical to the
+        uninterrupted run (greedy and sampled — the (seed,
+        tokens-generated) key schedule restores exactly, PR 5's
+        reconstruction argument across a process boundary).
         """
+        if recovery is not None and getattr(recovery, "sessions", None):
+            return self._run_recovered(
+                requests, recovery, drain=drain,
+                drain_deadline_s=drain_deadline_s, chaos=chaos)
         return self._run(requests, drain=drain,
                          drain_deadline_s=drain_deadline_s, chaos=chaos)
+
+    def _run_recovered(self, requests, recovery, **kw) -> list:
+        """Split a resubmitted request list against a recovery
+        manifest: journal-completed requests dedup (their recorded
+        stream IS the result), journal-incomplete ones become
+        continuation replays (prompt + emitted-so-far, remaining
+        budget, the journaled seed), everything else passes through
+        untouched. The merged result list is in request order and the
+        replayed sessions' results carry the FULL stream (recorded
+        prefix + newly decoded suffix) with ``recoveries`` bumped."""
+        n = len(requests)
+        pre: list[RequestResult | None] = [None] * n
+        sub: list[Request] = []
+        sub_meta: list[tuple[int, list]] = []   # (orig index, emitted)
+        replay_admits: dict = {}
+        for i, r in enumerate(requests):
+            rid = getattr(r, "request_id", None) or f"req-{i}"
+            # materialize the positional-default seed NOW: dedup below
+            # shifts positions, and a sampled replay must re-admit
+            # under the seed the original run actually used
+            seed = r.seed
+            if seed is None and r.temperature > 0.0:
+                seed = i
+            sess = recovery.sessions.get(rid)
+            if sess is None or getattr(sess, "prompt", None) is None:
+                sub_meta.append((i, []))
+                sub.append(replace(r, request_id=rid, seed=seed))
+                continue
+            if sess.completed:
+                # exactly-once emission: the journal already holds the
+                # terminal stream — return it, spend nothing
+                self.journal["deduped_completions"] += 1
+                pre[i] = RequestResult(
+                    status=sess.status, tokens=list(sess.emitted),
+                    error=sess.error, request_id=rid)
+                continue
+            emitted = [int(t) for t in sess.emitted]
+            seed = sess.seed if sess.seed is not None else seed
+            prompt = [int(t) for t in sess.prompt]
+            remaining = r.max_new - len(emitted)
+            cont = prompt + emitted
+            self.journal["recovered_sessions"] += 1
+            self.journal["recovery_replay_tokens"] += len(emitted)
+            instant("journal_session_replay", request_id=rid,
+                    emitted=len(emitted), remaining=max(0, remaining))
+            flight.record("journal_session_replay", request_id=rid,
+                          emitted=len(emitted),
+                          remaining=max(0, remaining))
+            if emitted and remaining < 1:
+                # the recorded stream already fills the budget — the
+                # crash hit between the last delta and the end frame;
+                # nothing left to decode
+                pre[i] = RequestResult(status=OK,
+                                       tokens=emitted[:r.max_new],
+                                       request_id=rid)
+                continue
+            if emitted and len(cont) <= self.Tb:
+                # continuation replay: the emitted tokens become prompt
+                # suffix — same (seed, logical-position) schedule, so
+                # the stream continues bit-exactly (see module-level
+                # soundness note in serve_journal.py)
+                sub_meta.append((i, emitted))
+                replay_admits[len(sub)] = (rid, prompt, emitted)
+                sub.append(replace(
+                    r, tokens=cont, max_new=remaining, seed=seed,
+                    request_id=rid, arrival_s=0.0))
+            else:
+                # full replay from scratch (budget spent, or the
+                # continuation outgrows the prompt window): same seed
+                # -> token-identical stream, just recomputed
+                sub_meta.append((i, []))
+                sub.append(replace(r, request_id=rid, seed=seed,
+                                   arrival_s=0.0))
+        self._replay_admits = replay_admits
+        try:
+            sub_results = self._run(sub, **kw)
+        finally:
+            self._replay_admits = {}
+        for (i, emitted), res in zip(sub_meta, sub_results):
+            if emitted and res is not None:
+                res = replace(res, tokens=emitted + list(res.tokens),
+                              recoveries=res.recoveries + 1)
+            pre[i] = res
+        return pre
 
     def _run(self, requests: list[Request], *, drain=None,
              drain_deadline_s: float | None = None, chaos=None) -> list:
@@ -1490,6 +1637,13 @@ class ContinuousBatcher:
                      for i in range(n)]
         admit_at: list[float | None] = [None] * n
         first_tok_at: list[float | None] = [None] * n
+        # journal identities: the positional default makes a whole call
+        # deterministic by id the same way the seed default does by
+        # stream; explicit ids win (the router / recovery replays set
+        # them)
+        jr = self._journal
+        jids = [getattr(requests[i], "request_id", None) or f"req-{i}"
+                for i in range(n)]
 
         def fin(i, status, tokens, error=None):
             if results[i] is not None:
@@ -1513,7 +1667,12 @@ class ContinuousBatcher:
                 latency_s=latency,
                 recoveries=recs[i],
                 cached_prefix_tokens=cached_prefix[i],
-                queue_wait_s=qw, ttft_s=ttft, tpot_s=tpot)
+                queue_wait_s=qw, ttft_s=ttft, tpot_s=tpot,
+                request_id=jids[i])
+            if jr is not None:
+                # terminal frame: no tokens (the admit's emitted prefix
+                # plus the deltas since already hold the stream)
+                jr.end(jids[i], status, error=error)
 
         # -- submission: validation failures are structured, not raised
         valid = []
@@ -1562,6 +1721,36 @@ class ContinuousBatcher:
                         f"requests > slots ({self.B}) + max_pending "
                         f"({self.max_pending}))")
                 queue = queue[:cap]
+
+        # -- write-ahead admission records: every request that survived
+        # submission is journaled BEFORE it can consume device work, so
+        # a crash at ANY later point finds its identity, prompt, params
+        # and materialized seed on disk. Replayed sessions record their
+        # TRUE shape (original prompt + emitted prefix), not the
+        # continuation prompt — a second crash recovers the full stream.
+        if jr is not None:
+            replays = self._replay_admits
+            for qi in queue:
+                r = requests[qi]
+                rep = replays.get(qi)
+                if rep is not None:
+                    rid, prompt, emitted = rep
+                    total_new = r.max_new + len(emitted)
+                else:
+                    rid, prompt, emitted = jids[qi], list(r.tokens), []
+                    total_new = r.max_new
+                jr.admit(
+                    rid, prompt, total_new,
+                    temperature=r.temperature, top_k=r.top_k,
+                    top_p=r.top_p,
+                    # the admission-time seed default (admit_wave uses
+                    # the request's index in THIS call) materializes
+                    # into the frame so a sampled replay restores the
+                    # identical stream
+                    seed=(r.seed if r.seed is not None
+                          else (qi if r.temperature > 0.0 else None)),
+                    deadline_s=r.deadline_s, emitted=emitted)
+            jr.commit()
 
         table = [_Slot() for _ in range(self.B)]
         admit_seq = [0]
@@ -2061,6 +2250,7 @@ class ContinuousBatcher:
                     ticks_charged[ri] += W
                     slot.remaining -= len(emit)
                     was_empty = not slot.out
+                    prev_out = len(slot.out)
                     slot.out.extend(emit)
                     self._row_pos[b] += len(emit)
                     self._nlog_h[b] += len(emit)
@@ -2077,9 +2267,14 @@ class ContinuousBatcher:
                         slot.out = slot.out[
                             :slot.out.index(self.eos_id) + 1]
                         done = True
+                    if jr is not None and len(slot.out) > prev_out:
+                        # post-trim: only DELIVERED tokens are journaled
+                        jr.delta(jids[ri], slot.out[prev_out:])
                     if done:
                         fin(ri, OK, slot.out)
                         free_row(b)
+                if jr is not None:
+                    jr.commit()        # harvest = the durability boundary
                 if self.spec["proposed"]:
                     self.spec["acceptance_rate"] = (
                         self.spec["accepted"] / self.spec["proposed"])
@@ -2132,6 +2327,7 @@ class ContinuousBatcher:
                     if slot.req_index != ri:
                         continue   # row re-admitted after an early free
                     was_empty = not slot.out
+                    prev_out = len(slot.out)
                     slot.out.extend(int(t) for t in toks_h[b, :take])
                     if (was_empty and slot.out
                             and first_tok_at[ri] is None):
@@ -2145,9 +2341,14 @@ class ContinuousBatcher:
                         slot.out = slot.out[
                             :slot.out.index(self.eos_id) + 1]
                         done = True
+                    if jr is not None and len(slot.out) > prev_out:
+                        # post-trim: only DELIVERED tokens are journaled
+                        jr.delta(jids[ri], slot.out[prev_out:])
                     if done:
                         fin(ri, OK, slot.out)
                         free_row(b)
+                if jr is not None:
+                    jr.commit()        # harvest = the durability boundary
 
         def handle_fault(e: BaseException) -> bool:
             """A device interaction failed (raised or hung). Recover by
@@ -2315,6 +2516,9 @@ class ContinuousBatcher:
         for i in range(n):
             if results[i] is None:
                 fin(i, FAILED, [], "not served (scheduler bug)")
+        if jr is not None:
+            jr.commit()    # exit-path terminal frames (drain sheds,
+                           # leftover-queue fins) reach the log too
         # a session that saw faults or chaos trips gets a final dump
         # even when every fault was absorbed without raising ("slow"
         # chaos never reaches handle_fault; a recovered session's

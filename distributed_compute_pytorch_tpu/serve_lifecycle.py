@@ -122,6 +122,10 @@ class RequestResult:
     tpot_s: float | None = None
     migrated: int = 0
     replica: int | None = None
+    # the request's stable identity (ISSUE 15): set from
+    # ``Request.request_id`` (or the engine's positional default) so
+    # journal recovery can dedup completed work by id, not by position
+    request_id: str | None = None
 
     @property
     def ok(self) -> bool:

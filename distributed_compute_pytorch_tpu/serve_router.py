@@ -270,7 +270,13 @@ class ServeRouter:
                       "failover_sheds": 0, "takeovers": 0, "probes": 0,
                       "probe_successes": 0, "unplaceable": 0,
                       "prefill_hops": 0, "handoffs": 0,
-                      "handoff_fallbacks": 0}
+                      "handoff_fallbacks": 0,
+                      # journal recovery at the router layer (ISSUE 15):
+                      # sessions resumed from a previous process's log,
+                      # completions returned without device work, and
+                      # the emitted tokens re-entered as replay prefix
+                      "journal_recovered": 0, "journal_deduped": 0,
+                      "journal_replay_tokens": 0}
         for i, rep in enumerate(self.replicas):
             self._wire_heartbeat(i, rep)
 
@@ -457,7 +463,7 @@ class ServeRouter:
         replay when the continuation outgrows the replica's prompt
         window), with the REMAINING wall budget as its deadline."""
         base = sess.req
-        if sess.rounds == 0:
+        if sess.rounds == 0 and not sess.tokens:
             return base
         cont = list(base.tokens) + list(sess.tokens)
         remaining = base.max_new - len(sess.tokens)
@@ -480,22 +486,42 @@ class ServeRouter:
 
     def route(self, requests: list[Request], *, drain=None,
               drain_deadline_s: float | None = None,
-              chaos: dict | None = None) -> list[RequestResult]:
+              chaos: dict | None = None,
+              recovery=None) -> list[RequestResult]:
         """Serve ``requests`` across the replica set; one
         :class:`RequestResult` per request, in order, never raising.
         ``drain`` is the cluster-wide SIGTERM latch (shared with every
         replica); ``chaos`` maps replica index -> ``ChaosInjector`` for
-        drills."""
+        drills.
+
+        ``recovery`` — a ``serve_journal.RecoveryManifest`` from a
+        previous process's journal: journal-completed requests dedup
+        by id (recorded stream, zero device work), journal-incomplete
+        ones enter round 0 with their emitted tokens as session state,
+        so the normal migration machinery replays them token-
+        identically (``_sub_request``'s continuation path — a recovery
+        IS a migration whose source replica was the dead process)."""
         t0 = time.monotonic()
         n = len(requests)
+        results: list[RequestResult | None] = [None] * n
+        rec_sessions = getattr(recovery, "sessions", None) or {}
         sessions: list[_Session] = []
         for j, r in enumerate(requests):
+            # materialise identity AND the single-batcher seed default
+            # (seed = index in the call) up front, so partitioning,
+            # migration and journal replay can never change a stream
+            rid = getattr(r, "request_id", None) or f"req-{j}"
             if r.temperature > 0 and r.seed is None:
-                # materialise the single-batcher default (seed = index
-                # in the call) so partitioning/migration can never
-                # change a sampled stream
-                r = replace(r, seed=j)
-            sessions.append(_Session(
+                r = replace(r, seed=j, request_id=rid)
+            elif r.request_id != rid:
+                r = replace(r, request_id=rid)
+            rsess = rec_sessions.get(rid)
+            if (rsess is not None and not rsess.completed
+                    and getattr(rsess, "seed", None) is not None
+                    and r.seed != rsess.seed):
+                # the journaled admission seed is the stream's truth
+                r = replace(r, seed=rsess.seed)
+            sess = _Session(
                 req=r, arrive_abs=t0 + getattr(r, "arrival_s", 0.0),
                 deadline_at=(t0 + r.deadline_s
                              if r.deadline_s is not None else None),
@@ -504,8 +530,32 @@ class ServeRouter:
                 # anyway, so skipping the tier saves it a migration
                 phase=("prefill" if self._prefill_set
                        and len(r.tokens) > 1 and r.max_new > 1
-                       else "decode")))
-        results: list[RequestResult | None] = [None] * n
+                       else "decode"))
+            if rsess is not None and rsess.prompt is not None:
+                if rsess.completed:
+                    # exactly-once emission across the crash
+                    self.stats["journal_deduped"] += 1
+                    results[j] = RequestResult(
+                        status=rsess.status,
+                        tokens=list(rsess.emitted), error=rsess.error,
+                        request_id=rid)
+                elif rsess.emitted:
+                    emitted = [int(t) for t in rsess.emitted]
+                    self.stats["journal_recovered"] += 1
+                    self.stats["journal_replay_tokens"] += len(emitted)
+                    instant("journal_session_replay", request_id=rid,
+                            emitted=len(emitted))
+                    if len(emitted) >= r.max_new:
+                        # budget already filled on disk — the crash hit
+                        # between the last delta and the end frame
+                        results[j] = RequestResult(
+                            status=OK, tokens=emitted[:r.max_new],
+                            request_id=rid)
+                    else:
+                        sess.tokens = emitted
+                        sess.recoveries = 1
+                        sess.phase = "decode"
+            sessions.append(sess)
         self.stats["routed"] += n
 
         def finalize(j: int, i: int | None, r: RequestResult,
@@ -514,7 +564,8 @@ class ServeRouter:
                 return                      # first terminal event wins
             sess = sessions[j]
             if sess.migrated == 0 and not sess.tokens:
-                results[j] = replace(r, replica=i)  # untouched fast path
+                results[j] = replace(r, replica=i,   # untouched fast path
+                                     request_id=sess.req.request_id)
                 return
             tokens = list(sess.tokens) + list(r.tokens)
             latency = max(0.0, now - sess.arrive_abs)
@@ -528,7 +579,8 @@ class ServeRouter:
                 cached_prefix_tokens=sess.cached_prefix
                 + r.cached_prefix_tokens,
                 queue_wait_s=sess.queue_wait_s, ttft_s=ttft, tpot_s=tpot,
-                migrated=sess.migrated, replica=i)
+                migrated=sess.migrated, replica=i,
+                request_id=sess.req.request_id)
 
         def shed_for(j: int, why: str, now: float,
                      drain_cut: bool = False) -> None:
@@ -539,7 +591,7 @@ class ServeRouter:
                 status = SHED
             finalize(j, None, RequestResult(status=status, error=why), now)
 
-        pending = list(range(n))
+        pending = [j for j in range(n) if results[j] is None]
         rounds = 0
         while pending:
             now = time.monotonic()

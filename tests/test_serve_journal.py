@@ -1,0 +1,588 @@
+"""Crash-durable serving (serve_journal.py): the process-death drills
+for ISSUE 15.
+
+PRs 5 and 11 shrank the serving failure domain to one request and one
+replica — both inside one process. These drills pin the next ring out:
+the write-ahead session journal's frame/CRC/torn-tail mechanics, the
+restartable disk tier's scan-on-open index rebuild, and the flagship
+crash-restart parity drills — kill a batcher (or a whole router fleet)
+mid-stream with a ``BaseException`` no handler can eat, restart from
+the journal, and demand the BIT-IDENTICAL token streams the unkilled
+run produces, greedy and sampled, with completed work deduped at zero
+device work. The llama+mesh variant and the real-SIGKILL
+``dcp-serve --supervise`` subprocess drill ride behind ``slow``
+(fresh XLA compiles per process).
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_compute_pytorch_tpu import serve_journal as sj
+from distributed_compute_pytorch_tpu.kv_tier import DiskTier
+from distributed_compute_pytorch_tpu.models.gpt2 import GPT2, GPT2Config
+from distributed_compute_pytorch_tpu.serve import ContinuousBatcher, Request
+from distributed_compute_pytorch_tpu.serve_lifecycle import ChaosInjector
+from distributed_compute_pytorch_tpu.serve_router import ServeRouter
+
+
+class Boom(BaseException):
+    """The crash lever: a BaseException subclass sails past every
+    ``except Exception`` recovery handler in the serve loop — from the
+    journal's point of view indistinguishable from SIGKILL (frames
+    simply stop), without paying a subprocess + fresh compile."""
+
+
+def _crash_at(seg_threshold):
+    def hook(seg):
+        if seg >= seg_threshold:
+            raise Boom(f"injected process death at segment {seg}")
+    return ChaosInjector(on_segment=hook)
+
+
+@pytest.fixture(scope="module")
+def gpt2():
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    return model, params
+
+
+# ---- journal unit layer -------------------------------------------------
+
+
+def test_journal_frame_roundtrip(tmp_path):
+    """Interleaved admit/delta/end frames for two sessions replay into
+    the right per-id state; completed vs incomplete partition by
+    terminal status."""
+    d = str(tmp_path)
+    j = sj.ServeJournal(d, fsync="every_harvest")
+    j.admit("r1", [1, 2, 3], 8, temperature=0.7, top_k=5, seed=5,
+            deadline_s=9.5)
+    j.delta("r1", [10, 11])
+    j.admit("r2", [4, 5], 4)
+    j.delta("r2", [20])
+    j.end("r2", "ok")
+    j.commit()
+    j.close()
+    assert j.stats["frames"] == 5 and j.stats["fsyncs"] >= 1
+
+    m = sj.recover(d)
+    assert m.frames == 5 and m.torn_bytes == 0
+    s1 = m.sessions["r1"]
+    assert (s1.prompt, s1.emitted, s1.status) == ([1, 2, 3], [10, 11], None)
+    assert (s1.temperature, s1.top_k, s1.seed, s1.deadline_s) == \
+        (0.7, 5, 5, 9.5)
+    assert not s1.completed
+    s2 = m.sessions["r2"]
+    assert s2.completed and s2.emitted == [20] and s2.status == "ok"
+    assert m.completed.keys() == {"r2"}
+    assert m.incomplete.keys() == {"r1"}
+
+
+def test_journal_torn_tail_truncates(tmp_path):
+    """Partial header, partial payload, and CRC-flipped frames are all
+    torn tails: recovery truncates at the last valid frame, never
+    raises, and the repair is idempotent."""
+    d = str(tmp_path)
+    j = sj.ServeJournal(d)
+    j.admit("r1", [1, 2], 4)
+    j.delta("r1", [7])
+    j.commit()
+    j.close()
+    wal = os.path.join(d, "serve.wal")
+    clean = os.path.getsize(wal)
+
+    # complete 8-byte header, missing payload -> 8 torn bytes
+    with open(wal, "ab") as f:
+        f.write(b"\x40\x00\x00\x00junk")
+    m = sj.recover(d)
+    assert m.frames == 2 and m.torn_bytes == 8
+    assert os.path.getsize(wal) == clean
+    # idempotent: the repaired file is already clean
+    assert sj.recover(d).torn_bytes == 0
+
+    # partial header (< 8 bytes)
+    with open(wal, "ab") as f:
+        f.write(b"\x03\x00")
+    assert sj.recover(d).torn_bytes == 2
+    assert os.path.getsize(wal) == clean
+
+    # CRC mismatch mid-payload of the LAST frame: flip a byte inside it
+    with open(wal, "rb") as f:
+        data = f.read()
+    with open(wal, "wb") as f:
+        f.write(data[:-1] + bytes([data[-1] ^ 0xFF]))
+    m = sj.recover(d)
+    assert m.frames == 1 and m.torn_bytes > 0
+    # the surviving frame is the admit; the delta was torn away
+    assert m.sessions["r1"].emitted == []
+
+    # the WRITER also repairs on open: appending after the torn tail
+    # must not bury the new frame behind a bad one
+    with open(wal, "ab") as f:
+        f.write(b"\xff\xff")
+    j2 = sj.ServeJournal(d)
+    assert j2.stats["torn_tail_truncations"] == 1
+    j2.delta("r1", [8])
+    j2.commit()
+    j2.close()
+    assert sj.recover(d).sessions["r1"].emitted == [8]
+
+
+def test_journal_readmit_rules(tmp_path):
+    """The recovery replay rules: a continuation re-admit (same prompt,
+    ``emitted`` prefix) resets the delta stream; a prompt-EXTENSION
+    admit (router migration sub-request shape) folds the extension into
+    ``emitted``; an end frame without an admit records a completion;
+    ``shed`` never dedups."""
+    d = str(tmp_path)
+    j = sj.ServeJournal(d)
+    # continuation re-admit after a crash that had banked [10, 11]
+    j.admit("r1", [1, 2, 3], 8, emitted=[10, 11], seed=5)
+    j.delta("r1", [12])
+    j.end("r1", "ok")
+    # router-migration style: second admit's prompt = prompt + partial
+    j.admit("r3", [7, 8], 6)
+    j.delta("r3", [30, 31])
+    j.admit("r3", [7, 8, 30, 31], 4)
+    j.delta("r3", [32])
+    # end-without-admit: a validation failure finalises pre-admission
+    j.end("r4", "failed", error="bad prompt")
+    # shed is terminal but NOT dedupable
+    j.admit("r5", [9], 3)
+    j.end("r5", "shed")
+    j.commit()
+    j.close()
+
+    m = sj.recover(d)
+    s1 = m.sessions["r1"]
+    assert s1.completed and s1.emitted == [10, 11, 12]
+    s3 = m.sessions["r3"]
+    assert s3.prompt == [7, 8] and s3.emitted == [30, 31, 32]
+    assert not s3.completed            # the re-admit re-opened it
+    s4 = m.sessions["r4"]
+    assert s4.completed and s4.prompt is None and s4.emitted == []
+    assert s4.error == "bad prompt"
+    s5 = m.sessions["r5"]
+    assert s5.status == "shed" and not s5.completed
+    # shed consumed zero device work: it re-runs (incomplete), never
+    # dedups as a completion
+    assert "r5" not in m.completed and "r5" in m.incomplete
+
+
+def test_journal_fsync_policies(tmp_path):
+    """``every_frame`` pays one fsync per frame, ``every_harvest`` one
+    per commit, ``os`` zero; unknown policies are rejected up front."""
+    with pytest.raises(ValueError, match="fsync"):
+        sj.ServeJournal(str(tmp_path / "bad"), fsync="always")
+    jf = sj.ServeJournal(str(tmp_path / "f"), fsync="every_frame")
+    jf.admit("r", [1], 2)
+    jf.delta("r", [3])
+    assert jf.stats["fsyncs"] == 2
+    jf.commit()
+    assert jf.stats["fsyncs"] == 2     # commit adds nothing new
+    jf.close()
+    jo = sj.ServeJournal(str(tmp_path / "o"), fsync="os")
+    jo.admit("r", [1], 2)
+    jo.commit()
+    jo.close()
+    assert jo.stats["fsyncs"] == 0
+    # bytes hit the page cache at commit even under os: a new reader
+    # (same or another process) sees the frame
+    assert sj.recover(str(tmp_path / "o")).frames == 1
+
+
+# ---- disk tier scan-on-open ---------------------------------------------
+
+
+def test_disk_tier_scan_on_open(tmp_path):
+    """A restarted DiskTier rebuilds its index from the JSON sidecars:
+    valid parts come back with their token keys, a corrupt sidecar
+    skips (but still advances the sequence counter so fresh puts can't
+    collide), and ``reset()`` removes every shard including strays."""
+    d = str(tmp_path)
+    t1 = DiskTier(d)
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((2, 2, 1, 2, 4, 3)).astype(np.float32)
+    b = rng.standard_normal((2, 2, 2, 2, 4, 3)).astype(np.float32)
+    ka = t1.put(a, tokens=[1, 2, 3])
+    kb = t1.put(b, tokens=[4, 5])
+    assert sorted(t1.index) == sorted([ka, kb])
+
+    t2 = DiskTier(d)
+    assert sorted(t2.index) == sorted([ka, kb])
+    assert t2.index[ka]["tokens"] == [1, 2, 3]
+    got, corrupt = t2.get(ka)
+    assert not corrupt and np.array_equal(got, a)
+    # sequence counter advanced past every scanned part
+    kc = t2.put(a, tokens=[6])
+    assert kc not in (ka, kb)
+
+    # corrupt one sidecar: that entry (only) drops on the next open
+    with open(os.path.join(d, kb + ".json"), "w") as f:
+        f.write("{not json")
+    t3 = DiskTier(d)
+    assert kb not in t3.index and {ka, kc} <= set(t3.index)
+    # ...but its sequence number is still burned
+    assert int(t3.put(a).split("-")[1]) > int(kb.split("-")[1])
+
+    t3.reset()
+    assert t3.index == {}
+    left = [n for n in os.listdir(d) if n.startswith("part-")]
+    assert left == [], left            # strays (kb's orphans) swept too
+
+
+# ---- crash-restart parity (tiny gpt2, shared compile) -------------------
+
+
+def test_crash_restart_parity(gpt2, tmp_path):
+    """The flagship drill: kill a journaling batcher mid-stream with a
+    BaseException (a stand-in for SIGKILL), restart, recover — the
+    restarted process must complete every session BIT-IDENTICALLY to
+    the unkilled reference, greedy AND sampled, with zero leaks; a
+    second restart dedups everything at zero device work."""
+    model, params = gpt2
+    kw = dict(slots=2, t_max=48, prompt_buf=32, segment=4)
+    rng = np.random.default_rng(3)
+    prompts = [[int(t) for t in rng.integers(0, 50, size=n)]
+               for n in (6, 9, 5)]
+
+    def reqs():
+        return [Request(list(p), 12,
+                        temperature=(0.8 if i == 1 else 0.0),
+                        top_k=(5 if i == 1 else None))
+                for i, p in enumerate(prompts)]
+
+    ref = ContinuousBatcher(model, params, **kw)
+    want = ref.serve_detailed(reqs())
+    assert all(r.ok for r in want)
+    # positional id default threads admission -> result
+    assert [r.request_id for r in want] == ["req-0", "req-1", "req-2"]
+
+    jd = str(tmp_path / "wal")
+    cb1 = ContinuousBatcher(model, params, **kw, journal_dir=jd)
+    with pytest.raises(Boom):
+        cb1.serve_detailed(reqs(), chaos=_crash_at(3))
+
+    man = sj.recover(jd)
+    assert man.incomplete              # the crash left sessions open
+
+    cb2 = ContinuousBatcher(model, params, **kw, journal_dir=jd)
+    got = cb2.serve_detailed(reqs(), recovery=man)
+    for w, g in zip(want, got):
+        assert g.ok and g.tokens == w.tokens, (w.tokens, g.tokens, g.error)
+        assert g.request_id == w.request_id
+    assert cb2.journal["recovered_sessions"] >= 1
+    assert cb2.journal["recovery_replay_tokens"] >= 1
+    assert cb2.last_slot_leaks == 0 and cb2.last_block_leaks == 0
+    # the recovered run's journal now shows every session complete:
+    # a THIRD process dedups the lot without touching the device
+    cb3 = ContinuousBatcher(model, params, **kw, journal_dir=jd)
+    got2 = cb3.serve_detailed(reqs(), recovery=sj.recover(jd))
+    assert [r.tokens for r in got2] == [r.tokens for r in want]
+    assert cb3.stats["segments"] == 0
+    assert cb3.journal["deduped_completions"] == len(prompts)
+
+
+def test_journal_on_off_parity_and_metrics(gpt2, tmp_path):
+    """A clean (uncrashed) run with the journal on is token-identical
+    to journal-off, and the ``serve.journal.*`` counters ride the
+    stats snapshot."""
+    model, params = gpt2
+    kw = dict(slots=2, t_max=48, prompt_buf=32, segment=4)
+    reqs = [Request([3, 1, 4, 1, 5], 8), Request([2, 7], 6)]
+    off = ContinuousBatcher(model, params, **kw)
+    want = off.serve(reqs)
+    on = ContinuousBatcher(model, params, **kw,
+                           journal_dir=str(tmp_path), journal_fsync="os")
+    assert on.serve(reqs) == want
+    snap = on.stats_snapshot()["journal"]
+    assert snap["frames"] >= 2 * len(reqs)        # admit + end per req
+    assert snap["bytes"] > 0 and snap["fsyncs"] == 0
+    # the journal outlived the call: a fresh recover sees completions
+    assert len(sj.recover(str(tmp_path)).completed) == len(reqs)
+
+
+def test_explicit_request_ids_thread_through(gpt2, tmp_path):
+    """Caller-supplied ids survive admission -> journal -> result, and
+    recovery dedups by ID, not position: re-submitting the same ids in
+    a different order returns each session's own stream."""
+    model, params = gpt2
+    kw = dict(slots=2, t_max=48, prompt_buf=32, segment=4)
+    reqs = [Request([3, 1, 4], 6, request_id="alpha"),
+            Request([1, 5, 9, 2], 6, request_id="beta")]
+    jd = str(tmp_path)
+    cb = ContinuousBatcher(model, params, **kw, journal_dir=jd)
+    res = cb.serve_detailed(reqs)
+    assert [r.request_id for r in res] == ["alpha", "beta"]
+    man = sj.recover(jd)
+    assert man.completed.keys() == {"alpha", "beta"}
+    cb2 = ContinuousBatcher(model, params, **kw)
+    swapped = cb2.serve_detailed(
+        [Request([1, 5, 9, 2], 6, request_id="beta"),
+         Request([3, 1, 4], 6, request_id="alpha")], recovery=man)
+    assert swapped[0].tokens == res[1].tokens
+    assert swapped[1].tokens == res[0].tokens
+    assert cb2.stats["segments"] == 0
+
+
+# ---- restartable disk tier under the serve engine -----------------------
+
+_TIER_KW = dict(slots=1, t_max=32, prompt_buf=24, segment=4,
+                prefix_cache=True, pool_blocks=8)
+
+
+def _hot(rng, n=3, ln=17):
+    return [[int(t) for t in rng.integers(0, 256, ln)] for _ in range(n)]
+
+
+def _tier_reqs(heads, seed=1, ids=None):
+    r = np.random.default_rng(seed)
+    return [Request(h + [int(t) for t in r.integers(0, 256, 2)], 6,
+                    request_id=None if ids is None else ids[i])
+            for i, h in enumerate(heads)]
+
+
+def test_warm_restart_disk_tier(gpt2, tmp_path):
+    """A restarted batcher adopts the previous process's spilled
+    shards (scan-on-open + ``adopt_disk_index``) and serves the same
+    stream token-identically WITH disk hits — the spilled KV outlives
+    the process, not just the HBM pool."""
+    model, params = gpt2
+    rng = np.random.default_rng(17)
+    stream = _hot(rng, 3) * 2                     # A B C A B C
+    off = ContinuousBatcher(model, params, **_TIER_KW)
+    want = [off.serve(_tier_reqs([h], seed=i))
+            for i, h in enumerate(stream)]
+
+    dd = str(tmp_path)
+    b1 = ContinuousBatcher(model, params, **_TIER_KW,
+                           host_cache_blocks=3, disk_cache_dir=dd)
+    got1 = [b1.serve(_tier_reqs([h], seed=i))
+            for i, h in enumerate(stream)]
+    assert got1 == want
+    b1._tier.disk.drain()
+    assert b1.tier["disk_spills"] >= 1
+
+    b2 = ContinuousBatcher(model, params, **_TIER_KW,
+                           host_cache_blocks=3, disk_cache_dir=dd)
+    assert b2.tier["disk_adopted"] >= 1
+    got2 = [b2.serve(_tier_reqs([h], seed=i))
+            for i, h in enumerate(stream)]
+    assert got2 == want
+    assert b2.tier["disk_hits"] >= 1 and b2.stats["prefix_hits"] >= 1
+    assert b2.last_block_leaks == 0 and b2.last_host_block_leaks == 0
+
+
+def test_crash_restart_with_disk_tier(gpt2, tmp_path):
+    """The acceptance drill: journal + disk tier together. Process 1
+    warms the disk tier and dies mid-stream; process 2 recovers the
+    journaled sessions AND re-attaches them to the adopted disk-tier
+    prefixes — at least one recovered request records a disk-backed
+    prefix hit, and every stream matches the unkilled reference."""
+    model, params = gpt2
+    rng = np.random.default_rng(17)
+    heads = _hot(rng, 3)
+    off = ContinuousBatcher(model, params, **_TIER_KW)
+    hot_reqs = _tier_reqs(heads, seed=7,
+                          ids=["hot-0", "hot-1", "hot-2"])
+    hot_want = off.serve_detailed([dataclasses.replace(r)
+                                   for r in hot_reqs])
+    assert all(r.ok for r in hot_want)
+
+    dd = str(tmp_path / "disk")
+    jd = str(tmp_path / "wal")
+    b1 = ContinuousBatcher(model, params, **_TIER_KW,
+                           host_cache_blocks=3, disk_cache_dir=dd,
+                           journal_dir=jd)
+    # two warm passes with DISTINCT tails per call (fresh inserts keep
+    # the pool starved): the round-robin demotions spill heads to disk
+    for p in range(2):
+        for i, h in enumerate(heads):
+            s = 3 * p + i
+            want = off.serve_detailed(_tier_reqs([h], seed=s))
+            got = b1.serve_detailed(
+                _tier_reqs([h], seed=s, ids=[f"warm-{p}-{i}"]))
+            assert got[0].tokens == want[0].tokens
+    b1._tier.disk.drain()
+    assert b1.tier["disk_spills"] >= 1
+    with pytest.raises(Boom):          # hot pass dies mid-stream
+        b1.serve_detailed([dataclasses.replace(r) for r in hot_reqs],
+                          chaos=_crash_at(2))
+
+    man = sj.recover(jd)
+    assert {"hot-0", "hot-1", "hot-2"} <= man.sessions.keys()
+    b2 = ContinuousBatcher(model, params, **_TIER_KW,
+                           host_cache_blocks=3, disk_cache_dir=dd,
+                           journal_dir=jd)
+    assert b2.tier["disk_adopted"] >= 1
+    got = b2.serve_detailed([dataclasses.replace(r) for r in hot_reqs],
+                            recovery=man)
+    for w, g in zip(hot_want, got):
+        assert g.ok and g.tokens == w.tokens, (w.tokens, g.tokens, g.error)
+    # the restarted process hit the previous process's spilled KV
+    assert b2.tier["disk_hits"] >= 1 and b2.stats["prefix_hits"] >= 1
+    assert b2.last_block_leaks == 0 and b2.last_host_block_leaks == 0
+
+
+# ---- router recovery ----------------------------------------------------
+
+
+def test_router_crash_restart_parity(gpt2, tmp_path):
+    """Both replicas of a journaling fleet die mid-stream (the
+    whole-process crash a router cannot migrate around); a restarted
+    fleet recovers from the shared journal and matches the unkilled
+    reference bit-for-bit, with at least one session resuming from
+    journaled deltas rather than restarting from scratch."""
+    model, params = gpt2
+    kw = dict(slots=2, t_max=48, prompt_buf=32, segment=4)
+    rng = np.random.default_rng(3)
+    prompts = [[int(t) for t in rng.integers(0, 50, size=n)]
+               for n in (6, 9, 5, 7)]
+
+    def reqs():
+        return [Request(list(p), 10,
+                        temperature=(0.8 if i == 1 else 0.0))
+                for i, p in enumerate(prompts)]
+
+    ref = ServeRouter([ContinuousBatcher(model, params, **kw)
+                       for _ in range(2)])
+    want = ref.route(reqs())
+    assert all(r.ok for r in want)
+
+    jd = str(tmp_path)
+    j1 = sj.ServeJournal(jd)           # one shared writer per process
+    r1 = ServeRouter([ContinuousBatcher(model, params, **kw, journal=j1)
+                      for _ in range(2)])
+    # crash late enough that harvest deltas landed before death (the
+    # fleet runs 3 segments/replica clean; at segment 3 each session
+    # has one harvested delta banked)
+    r1.route(reqs(), chaos={0: _crash_at(3), 1: _crash_at(3)})
+    j1.close()
+
+    man = sj.recover(jd)
+    assert any(s.emitted for s in man.incomplete.values())
+    j2 = sj.ServeJournal(jd)
+    r2 = ServeRouter([ContinuousBatcher(model, params, **kw, journal=j2)
+                      for _ in range(2)])
+    got = r2.route(reqs(), recovery=man)
+    for w, g in zip(want, got):
+        assert g.ok and g.tokens == w.tokens, (w.tokens, g.tokens, g.error)
+    assert r2.stats["journal_recovered"] >= 1
+    assert r2.stats["journal_replay_tokens"] >= 1
+    j2.close()
+
+
+# ---- slow: llama+mesh parity and the real-SIGKILL supervisor drill ------
+
+
+@pytest.mark.slow
+def test_crash_restart_parity_llama_mesh(tmp_path, devices8):
+    """The recovery soundness argument is layout-independent: the same
+    kill/recover drill under a sharded llama (data=2,tensor=2) must
+    reproduce the unkilled sharded reference exactly."""
+    from distributed_compute_pytorch_tpu.core.mesh import make_mesh
+    from distributed_compute_pytorch_tpu.models.llama import (
+        LlamaConfig, LlamaLM)
+    from distributed_compute_pytorch_tpu.parallel.api import (
+        pick_strategy, shard_pytree)
+
+    model = LlamaLM(dataclasses.replace(LlamaConfig.tiny(),
+                                        max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    mesh = make_mesh("data=2,tensor=2", devices=devices8)
+    sharded = shard_pytree(params, pick_strategy(mesh, model), mesh)
+    kw = dict(slots=4, t_max=64, prompt_buf=10, segment=3, mesh=mesh)
+    rng = np.random.default_rng(5)
+    prompts = [[int(t) for t in rng.integers(0, 256, size=n)]
+               for n in (4, 7, 3, 6)]
+
+    def reqs():
+        return [Request(list(p), 8,
+                        temperature=(0.7 if i == 2 else 0.0))
+                for i, p in enumerate(prompts)]
+
+    ref = ContinuousBatcher(model, sharded, **kw)
+    want = ref.serve_detailed(reqs())
+    assert all(r.ok for r in want)
+
+    jd = str(tmp_path)
+    cb1 = ContinuousBatcher(model, sharded, **kw, journal_dir=jd)
+    with pytest.raises(Boom):
+        cb1.serve_detailed(reqs(), chaos=_crash_at(2))
+    cb2 = ContinuousBatcher(model, sharded, **kw, journal_dir=jd)
+    got = cb2.serve_detailed(reqs(), recovery=sj.recover(jd))
+    for w, g in zip(want, got):
+        assert g.ok and g.tokens == w.tokens, (w.tokens, g.tokens, g.error)
+    assert cb2.journal["recovered_sessions"] >= 1
+
+
+@pytest.mark.slow
+def test_cli_supervise_sigkill_subprocess(tmp_path):
+    """The end-to-end drill: ``dcp-serve --journal_dir --supervise`` in
+    a real process tree, SIGKILL the serving child once the journal
+    shows harvest deltas — the supervisor respawns it, the respawn
+    recovers from the journal, and the final output holds one 'ok'
+    line per request with full token streams."""
+    from distributed_compute_pytorch_tpu.core.config import Config
+    from distributed_compute_pytorch_tpu.data.datasets import synthetic_lm
+    from distributed_compute_pytorch_tpu.train.trainer import Trainer
+
+    ck = str(tmp_path / "ck.npz")
+    data = synthetic_lm(64, seq_len=128, vocab=256, seed=9)
+    cfg = Config(batch_size=32, lr=1e-3, epochs=1, mesh="data=1",
+                 model="gpt2", model_preset="tiny",
+                 dataset="synthetic-lm", optimizer="adamw", ckpt_path=ck,
+                 force_cpu=True)
+    Trainer(cfg, train_data=data, eval_data=data).fit()
+
+    n_req = 24
+    reqfile = tmp_path / "reqs.txt"
+    reqfile.write_text("".join(
+        json.dumps({"id": f"r{i:03d}", "tokens": [(i % 200) + 1, 2, 3],
+                    "max_new": 64}) + "\n" for i in range(n_req)))
+    jd = tmp_path / "wal"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_compute_pytorch_tpu.cli_serve",
+         "--ckpt_path", ck, "--model", "gpt2", "--model_preset", "tiny",
+         "--max_seq_len", "128", "--requests", str(reqfile),
+         "--slots", "2", "--segment", "4",
+         "--journal_dir", str(jd), "--journal_fsync", "os",
+         "--supervise", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        text=True)
+    # wait until the serving CHILD has journaled real progress, then
+    # SIGKILL it (the supervisor survives and must respawn)
+    wal = jd / "serve.wal"
+    deadline = time.time() + 240
+    killed = False
+    while time.time() < deadline and proc.poll() is None:
+        if wal.exists() and b'"kind":"delta"' in wal.read_bytes():
+            kids = subprocess.run(
+                ["pgrep", "-P", str(proc.pid)],
+                capture_output=True, text=True).stdout.split()
+            if kids:
+                os.kill(int(kids[0]), signal.SIGKILL)
+                killed = True
+                break
+        time.sleep(0.25)
+    assert killed, "child never journaled a delta before the deadline"
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, (proc.returncode, err[-2000:])
+    assert "serve process died" in err  # the supervisor restarted it
+    lines = [json.loads(ln) for ln in out.strip().splitlines()]
+    assert len(lines) == n_req
+    assert all(ln["status"] == "ok" for ln in lines)
+    assert all(len(ln["new"]) == 64 for ln in lines)
+    assert sorted(ln["id"] for ln in lines) == \
+        sorted(f"r{i:03d}" for i in range(n_req))
