@@ -25,7 +25,7 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
-	serve-tier-smoke serve-spec-smoke serve-load-smoke \
+	serve-tier-smoke serve-spec-smoke serve-kvq-smoke serve-load-smoke \
 	serve-router-smoke serve-disagg-smoke serve-journal-smoke bench-diff
 
 tier1:
@@ -77,6 +77,15 @@ bench:
 #   tokens per verify window exceed 1 (each window costs one weight
 #   stream — the >1.5x hardware-target mechanism), auto-disable never
 #   trips, and no block/slot leaks; records walls with spread
+# - serve-kvq: the quantized KV pool A/B (--kv_dtype int8) — the same
+#   Poisson hot-prefix stream on bf16 vs int8 engines, then every
+#   serving drill repeated under int8 (spec decode, host+disk spill,
+#   prefix handoff + its corrupt-scale/dtype-stamp declines,
+#   crash-restart reconstruction + journal replay); fails unless
+#   greedy match >= 99% with per-position KL finite and small,
+#   resident prefix tokens per pool byte >= 1.8x bf16, scale CRCs
+#   stay clean, every decline is counted instead of raised, and no
+#   engine leaks a slot/block/host block
 # - serve-load: the open-loop Poisson load drill over the telemetry
 #   subsystem (obs/); fails unless goodput > 0 with finite p99 TTFT,
 #   tokens are identical to the unloaded path, no slot/block leaks,
@@ -116,6 +125,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-prefix-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-tier-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-spec-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-kvq-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke
@@ -146,6 +156,9 @@ serve-tier-smoke:
 
 serve-spec-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-spec-smoke
+
+serve-kvq-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-kvq-smoke
 
 serve-load-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke

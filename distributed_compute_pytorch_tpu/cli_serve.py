@@ -252,6 +252,17 @@ def main(argv=None) -> int:
                         "and decode outputs back to text")
     p.add_argument("--quantize", default=None, choices=("int8",),
                    help="weight-only int8 serving")
+    p.add_argument("--kv_dtype", default="bf16", choices=("bf16", "int8"),
+                   help="KV-cache pool storage dtype (ISSUE 16): int8 "
+                        "stores each block as int8 with per-row f32 "
+                        "scales — roughly half the HBM/host/disk/"
+                        "handoff bytes per cached token, so ~1.9x the "
+                        "resident prefix tokens per byte. Outputs are "
+                        "NOT bit-identical to bf16 (bounded logit "
+                        "error; >=99% greedy match on the bench "
+                        "streams — see DESIGN.md 'Quantized KV'). "
+                        "Replicas inherit; a journaled run refuses to "
+                        "recover under a different kv_dtype")
     p.add_argument("--mesh", default=None,
                    help="mesh spec for SHARDED serving (e.g. "
                         "data=2,tensor=2): cache rows shard over the "
@@ -461,6 +472,23 @@ def main(argv=None) -> int:
         # respawns on abnormal death — before any signal handlers or
         # device state exist in the parent
         return _supervise(args.supervise, argv)
+    # crash durability, step 1: recover BEFORE the heavy imports and
+    # checkpoint load — a config mismatch against the journaled run
+    # (kv_dtype: the recorded streams are promises another pool dtype
+    # cannot keep) must refuse in one line, not after a full compile
+    recovery = None
+    if args.journal_dir:
+        from distributed_compute_pytorch_tpu import serve_journal
+        recovery = serve_journal.recover(args.journal_dir)
+        jc = recovery.config or {}
+        # a fresh/empty journal has nothing to mismatch; a non-empty
+        # one without a config frame is a pre-config-frame journal,
+        # which only a bf16 engine could have written
+        if recovery.frames and jc.get("kv_dtype", "bf16") != args.kv_dtype:
+            raise SystemExit(
+                f"--journal_dir was written with kv_dtype="
+                f"{jc.get('kv_dtype', 'bf16')}, refusing to recover "
+                f"with --kv_dtype {args.kv_dtype}")
     # SIGTERM/SIGINT -> graceful drain, armed BEFORE the heavy imports /
     # checkpoint load / compiles so a preemption at ANY point of startup
     # drains instead of dying mid-load (the trainer's PreemptionGuard,
@@ -555,15 +583,14 @@ def main(argv=None) -> int:
             metrics_f.write(line + "\n")
             metrics_f.flush()
 
-    # crash durability: recover FIRST (the manifest is what the previous
-    # process managed to make durable), then open the writer — both
-    # repair a torn tail, so either finds a clean log. One shared writer
-    # for every replica: frames interleave, recovery keys by id.
-    recovery = None
+    # crash durability, step 2: the manifest was recovered (and its
+    # config validated) up top, before the checkpoint load; open the
+    # writer now — both ends repair a torn tail, so either order finds
+    # a clean log. One shared writer for every replica: frames
+    # interleave, recovery keys by id.
     journal = None
     if args.journal_dir:
         from distributed_compute_pytorch_tpu import serve_journal
-        recovery = serve_journal.recover(args.journal_dir)
         if recovery.sessions:
             print(json.dumps({
                 "kind": "serve_recovery", "ts": time.time(),
@@ -574,6 +601,9 @@ def main(argv=None) -> int:
                 file=sys.stderr, flush=True)
         journal = serve_journal.ServeJournal(args.journal_dir,
                                              fsync=args.journal_fsync)
+        # stamp this process's config so the NEXT restart can refuse a
+        # mismatched --kv_dtype before touching any session
+        journal.config({"kv_dtype": args.kv_dtype})
 
     def build_batcher(replica=None):
         hb_cb = None
@@ -600,7 +630,8 @@ def main(argv=None) -> int:
             on_heartbeat=hb_cb,
             speculate=args.speculate or None,
             prefill_chunk_tokens=args.prefill_chunk_tokens,
-            journal=journal)
+            journal=journal,
+            kv_dtype=args.kv_dtype)
 
     router = None
     if args.replicas > 1:

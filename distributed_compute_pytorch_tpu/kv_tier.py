@@ -82,11 +82,23 @@ def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
+def _content_nbytes(content) -> int:
+    """Total bytes of a tier content payload: a bare K/V array (bf16
+    pools) or a ``{"kv", "scale"}`` dict (int8 pools, ISSUE 16)."""
+    if isinstance(content, dict):
+        return sum(int(v.nbytes) for v in content.values())
+    return int(content.nbytes)
+
+
 def host_blocks_for_mb(mb: float, n_layers: int, hk: int, bt: int,
-                       hd: int, itemsize: int) -> int:
+                       hd: int, itemsize: int,
+                       scale_itemsize: int = 0) -> int:
     """How many host blocks a ``--host_cache_mb`` budget buys: one
-    logical block spans every layer's K and V slab."""
-    per_block = 2 * n_layers * hk * bt * hd * itemsize
+    logical block spans every layer's K and V slab. Quantized pools
+    pass ``scale_itemsize`` (4 for the f32 per-row scales) so the
+    budget accounts for the scale slabs riding beside the int8 bytes
+    — the same MB buys roughly ``2*hd/(hd+4)``x the blocks."""
+    per_block = 2 * n_layers * hk * bt * (hd * itemsize + scale_itemsize)
     return max(1, int(mb * 2**20) // per_block)
 
 
@@ -98,7 +110,7 @@ class HostBlockPool:
     device tier."""
 
     def __init__(self, num_blocks: int, n_layers: int, hk: int, bt: int,
-                 hd: int, dtype):
+                 hd: int, dtype, scale_dtype=None):
         if num_blocks < 1:
             raise ValueError(f"need >= 1 host blocks, got {num_blocks}")
         self.num_blocks = num_blocks
@@ -106,6 +118,15 @@ class HostBlockPool:
         self.dtype = np.dtype(dtype)
         self.data = [np.zeros((2, num_blocks, hk, bt, hd), self.dtype)
                      for _ in range(n_layers)]
+        # quantized pools (ISSUE 16): per-row f32 scales live beside
+        # the int8 bytes in a mirrored [2, blocks, hk, bt, 1] slab, so
+        # a demoted block round-trips bit-exactly (no requantization)
+        self.scale_dtype = (None if scale_dtype is None
+                            else np.dtype(scale_dtype))
+        self.scale = ([np.zeros((2, num_blocks, hk, bt, 1),
+                                self.scale_dtype)
+                       for _ in range(n_layers)]
+                      if self.scale_dtype is not None else None)
         self._free = list(range(num_blocks - 1, -1, -1))
         self.high_water = 0
 
@@ -128,13 +149,30 @@ class HostBlockPool:
             assert b not in self._free, b
             self._free.append(b)
 
-    def read(self, blocks) -> np.ndarray:
+    def read(self, blocks):
         """The stored K/V for ``blocks``: ``[L, 2, n, hk, bt, hd]``
-        (a copy — callers release the blocks right after)."""
-        return np.stack([d[:, blocks] for d in self.data])
+        (a copy — callers release the blocks right after). With scale
+        slabs, a ``{"kv", "scale"}`` dict instead of a bare array."""
+        kv = np.stack([d[:, blocks] for d in self.data])
+        if self.scale is None:
+            return kv
+        return {"kv": kv,
+                "scale": np.stack([s[:, blocks] for s in self.scale])}
 
-    def write(self, blocks, content: np.ndarray) -> None:
-        """Store ``content [L, 2, n, hk, bt, hd]`` at ``blocks``."""
+    def write(self, blocks, content) -> None:
+        """Store ``content [L, 2, n, hk, bt, hd]`` (or the dict form
+        with scales) at ``blocks``."""
+        if isinstance(content, dict):
+            if ("scale" in content) != (self.scale is not None):
+                raise ValueError("scale payload/slab mismatch")
+            for li, d in enumerate(self.data):
+                d[:, blocks] = content["kv"][li]
+            if self.scale is not None:
+                for li, s in enumerate(self.scale):
+                    s[:, blocks] = content["scale"][li]
+            return
+        if self.scale is not None:
+            raise ValueError("quantized host pool needs a scale payload")
         for li, d in enumerate(self.data):
             d[:, blocks] = content[li]
 
@@ -144,16 +182,22 @@ class HostBlockPool:
         radix that indexes them is untrusted and cleared)."""
         for d in self.data:
             d[:] = 0
+        if self.scale is not None:
+            for s in self.scale:
+                s[:] = 0
         self._free = list(range(self.num_blocks - 1, -1, -1))
 
 
 class DiskTier:
     """CRC-verified spill directory below the host pool. One radix
     entry per ``part-NNNNN.npz`` (array key ``kv``, shape
-    ``[L, 2, n, hk, bt, hd]``) with a ``part-NNNNN.json`` sidecar
-    recording the v2-format entry CRC. Reads verify the CRC against
-    the sidecar; ANY mismatch or I/O error degrades to a cache miss —
-    the serving path never raises on tier-3 bytes.
+    ``[L, 2, n, hk, bt, hd]``; quantized entries add a ``scale``
+    array whose own CRC/geometry ride the sidecar as
+    ``scale_crc``/``scale_shape``/``scale_dtype`` — ISSUE 16) with a
+    ``part-NNNNN.json`` sidecar recording the v2-format entry CRC.
+    Reads verify the CRC against the sidecar — BOTH leaves for
+    quantized parts; ANY mismatch or I/O error degrades to a cache
+    miss — the serving path never raises on tier-3 bytes.
 
     With ``async_writes=True`` (the serve engine's setting) ``put``
     returns as soon as the bytes are queued: a daemon writer thread
@@ -172,7 +216,7 @@ class DiskTier:
         self.index: dict[str, dict] = {}
         self.async_writes = async_writes
         self._mu = threading.Lock()
-        self._pending: dict[str, np.ndarray] = {}
+        self._pending: dict = {}     # key -> array or {"kv","scale"}
         self._q: queue.Queue = queue.Queue()
         self._writer: threading.Thread | None = None
         self._scan_on_open()
@@ -222,9 +266,10 @@ class DiskTier:
             if self.get(spot)[0] is None:
                 self.index.pop(spot, None)
 
-    def _write_part(self, key: str, content: np.ndarray,
-                    rec: dict) -> None:
-        np.savez(os.path.join(self.root, key + ".npz"), kv=content)
+    def _write_part(self, key: str, content, rec: dict) -> None:
+        arrays = (dict(content) if isinstance(content, dict)
+                  else {"kv": content})
+        np.savez(os.path.join(self.root, key + ".npz"), **arrays)
         with open(os.path.join(self.root, key + ".json"), "w") as f:
             json.dump(rec, f)
 
@@ -254,12 +299,18 @@ class DiskTier:
             finally:
                 self._q.task_done()
 
-    def put(self, content: np.ndarray, tokens=()) -> str:
+    def put(self, content, tokens=()) -> str:
         key = f"part-{self._seq:05d}"
         self._seq += 1
-        rec = {"key": key, "crc": _crc(content),
-               "shape": list(content.shape), "dtype": str(content.dtype),
+        kv = content["kv"] if isinstance(content, dict) else content
+        rec = {"key": key, "crc": _crc(kv),
+               "shape": list(kv.shape), "dtype": str(kv.dtype),
                "tokens": [int(t) for t in tokens]}
+        if isinstance(content, dict) and "scale" in content:
+            sc = content["scale"]
+            rec["scale_crc"] = _crc(sc)
+            rec["scale_shape"] = list(sc.shape)
+            rec["scale_dtype"] = str(sc.dtype)
         if not self.async_writes:
             self._write_part(key, content, rec)
             self.index[key] = rec
@@ -275,11 +326,12 @@ class DiskTier:
         self._q.put(key)
         return key
 
-    def get(self, key: str) -> tuple[np.ndarray | None, bool]:
-        """``(content, corrupt)``: the verified bytes, or ``(None,
-        True)`` when the part exists but fails its CRC/shape check (or
-        cannot be read at all), ``(None, False)`` for an unknown
-        key."""
+    def get(self, key: str):
+        """``(content, corrupt)``: the verified bytes — a bare ``kv``
+        array, or a ``{"kv", "scale"}`` dict for quantized parts — or
+        ``(None, True)`` when the part exists but fails its CRC/shape
+        check ON EITHER LEAF (or cannot be read at all),
+        ``(None, False)`` for an unknown key."""
         with self._mu:
             rec = self.index.get(key)
             content = self._pending.get(key)
@@ -291,11 +343,19 @@ class DiskTier:
         try:
             with np.load(path) as z:
                 arr = np.asarray(z["kv"])
+                sc = (np.asarray(z["scale"])
+                      if "scale_crc" in rec else None)
             if (list(arr.shape) != rec["shape"]
                     or str(arr.dtype) != rec["dtype"]
                     or _crc(arr) != rec["crc"]):
                 return None, True
-            return arr, False
+            if sc is None:
+                return arr, False
+            if (list(sc.shape) != rec.get("scale_shape")
+                    or str(sc.dtype) != rec.get("scale_dtype")
+                    or _crc(sc) != rec.get("scale_crc")):
+                return None, True
+            return {"kv": arr, "scale": sc}, False
         except Exception:
             return None, True
 
@@ -359,13 +419,15 @@ class KVTierManager:
 
     # ---- demotion (device -> host [-> disk]) ---------------------------
 
-    def store(self, entry, content: np.ndarray) -> bool:
+    def store(self, entry, content) -> bool:
         """Capture an evicted entry's K/V ``[L, 2, n, hk, bt, hd]``
-        into the host tier, spilling host-LRU entries to disk (or
-        dropping them, diskless) to make room. False = no room even
-        after spilling everything — the entry is discarded, the
+        (bare array, or the ``{"kv", "scale"}`` dict from a quantized
+        pool) into the host tier, spilling host-LRU entries to disk
+        (or dropping them, diskless) to make room. False = no room
+        even after spilling everything — the entry is discarded, the
         pre-tier behaviour."""
-        n = content.shape[2]
+        kv = content["kv"] if isinstance(content, dict) else content
+        n = kv.shape[2]
         if n > self.host.num_blocks:
             return False
         while self.host.free_count < n:
@@ -378,7 +440,7 @@ class KVTierManager:
         entry.disk_key = None
         self._demoted.append(entry)
         self.stats["demotions"] += 1
-        self.stats["bytes_d2h"] += int(content.nbytes)
+        self.stats["bytes_d2h"] += _content_nbytes(content)
         self.stats["host_pool_occupancy"] = max(
             self.stats["host_pool_occupancy"],
             self.host.allocated / self.host.num_blocks)
@@ -406,17 +468,19 @@ class KVTierManager:
 
     # ---- promotion (host/disk -> device) -------------------------------
 
-    def fetch(self, entry) -> np.ndarray | None:
+    def fetch(self, entry):
         """Take a demoted entry's bytes for promotion (a MOVE: the
-        spill copy is released). None on a disk miss — the entry is
-        already gone from the tree and the caller re-prefills."""
+        spill copy is released) — bare array, or the ``{"kv",
+        "scale"}`` dict for quantized pools. None on a disk miss —
+        the entry is already gone from the tree and the caller
+        re-prefills."""
         if entry.tier == TIER_HOST:
             content = self.host.read(entry.host_blocks)
             self.host.release(entry.host_blocks)
             entry.host_blocks = []
             self._demoted.remove(entry)
             self.stats["host_hits"] += 1
-            self.stats["bytes_h2d"] += int(content.nbytes)
+            self.stats["bytes_h2d"] += _content_nbytes(content)
             return content
         if entry.tier == TIER_DISK:
             content, corrupt = self.disk.get(entry.disk_key)
@@ -440,7 +504,7 @@ class KVTierManager:
             entry.disk_key = None
             self._demoted.remove(entry)
             self.stats["disk_hits"] += 1
-            self.stats["bytes_h2d"] += int(content.nbytes)
+            self.stats["bytes_h2d"] += _content_nbytes(content)
             return content
         raise AssertionError(f"fetch on resident entry {entry.tier}")
 
@@ -451,12 +515,15 @@ class KVTierManager:
         rebuilt index: every shard whose sidecar carries its prefix
         tokens re-enters the tree as a TIER_DISK entry, so the first
         request sharing that prefix promotes it instead of paying cold
-        prefill. ``expect(n_tokens) -> (shape, dtype_str)`` is the
-        adopting engine's geometry — a shard written under a different
-        model config, block size, or dtype is skipped (adopting it
-        would feed the compiled promote a mis-shaped array), as is any
-        prefix already resident. Returns the number of entries
-        adopted."""
+        prefill. ``expect(n_tokens) -> (shape, dtype_str)`` — or the
+        4-tuple ``(shape, dtype_str, scale_shape, scale_dtype_str)``
+        from a quantized engine — is the adopting engine's geometry:
+        a shard written under a different model config, block size, or
+        dtype is skipped (adopting it would feed the compiled promote
+        a mis-shaped array), a scale-carrying shard never adopts into
+        a bf16 pool and vice versa, and the scale geometry must match
+        too. As is any prefix already resident. Returns the number of
+        entries adopted."""
         if self.disk is None:
             return 0
         adopted = 0
@@ -465,10 +532,23 @@ class KVTierManager:
             toks = rec.get("tokens") or []
             if not toks:
                 continue             # pre-journal shard: no identity
-            shape, dtype = expect(len(toks))
+            exp = expect(len(toks))
+            shape, dtype = exp[0], exp[1]
+            want_scale = exp[2:] if len(exp) > 2 else None
             if (list(rec.get("shape", [])) != list(shape)
                     or rec.get("dtype") != str(dtype)):
                 continue
+            has_scale = "scale_crc" in rec
+            if want_scale is None:
+                if has_scale:        # int8 shard, bf16 engine
+                    continue
+            else:
+                if (not has_scale
+                        or list(rec.get("scale_shape", []))
+                        != list(want_scale[0])
+                        or rec.get("scale_dtype")
+                        != str(want_scale[1])):
+                    continue
             entry = self.radix.insert_demoted([int(t) for t in toks])
             if entry is None:        # prefix already in the tree
                 continue

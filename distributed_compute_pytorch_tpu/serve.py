@@ -192,6 +192,7 @@ from distributed_compute_pytorch_tpu.serve_journal import JOURNAL_STATS
 from distributed_compute_pytorch_tpu.serve_lifecycle import (
     CANCELLED, FAILED, OK, SHED, TIMEOUT, RequestResult)
 from distributed_compute_pytorch_tpu.train.elastic import call_with_timeout
+from distributed_compute_pytorch_tpu.utils.quantize import quantize_kv
 
 # (model class, model config, block tokens, segment, mesh devices+axes)
 # -> weakref to the first live batcher that jitted programs for that
@@ -370,6 +371,21 @@ class ContinuousBatcher:
         models (routing is group-dependent, the prefix-cache
         precedent). Sustained low acceptance auto-disables back to
         plain segment decode (``SpecConfig.autodisable_*``).
+      kv_dtype: the POOL's storage dtype (DESIGN.md "Quantized KV").
+        ``"bf16"`` (default) stores blocks in the params' activation
+        dtype — the exact, token-identical path. ``"int8"`` stores each
+        block as symmetric int8 with per-(position, head) f32 scales
+        in a ``"scale"`` leaf beside ``"kv"`` (``utils/quantize.py::
+        quantize_kv``): quantization fuses into every write (admission
+        scatter, decode/verify tick — ``ops/attention.py`` branches on
+        the scale leaf) and dequantization into every gathered read,
+        roughly doubling resident prefix tokens per HBM/host/disk/
+        handoff byte. Token-identical parity is SURRENDERED at int8;
+        the replacement contract is bounded per-position logit error
+        and ≥99% greedy match (the ``--serve-kvq-smoke`` A/B gate).
+        Radix keys, CRC stamps and journal replay stay dtype-agnostic
+        (they key on token ids, not bytes); handoff payloads carry a
+        dtype stamp and mixed-dtype imports decline to replay.
 
     Telemetry (ISSUE 8): every batcher owns a private
     ``obs.metrics.Registry`` (``self.obs``); ``stats``/``waste`` are
@@ -398,7 +414,8 @@ class ContinuousBatcher:
                  speculate=None,
                  journal=None,
                  journal_dir: str | None = None,
-                 journal_fsync: str = "every_harvest"):
+                 journal_fsync: str = "every_harvest",
+                 kv_dtype: str = "bf16"):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -429,6 +446,9 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prefill_chunk_tokens must be >= 1, got "
                 f"{prefill_chunk_tokens}")
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}")
         _tier_on = (host_cache_mb is not None
                     or host_cache_blocks is not None
                     or disk_cache_dir is not None)
@@ -549,17 +569,22 @@ class ContinuousBatcher:
             self._dp = 1
         n_layers = int(jax.tree_util.tree_leaves(
             params["blocks"])[0].shape[0])
-        # cache blocks in the activations' dtype == the first floating
-        # param leaf's (bf16 serving params -> bf16 cache; int8-quantized
-        # trees surface their float scales, same outcome)
+        # compute dtype == the first floating param leaf's (bf16 serving
+        # params -> bf16 activations; int8-quantized trees surface their
+        # float scales, same outcome). kv_dtype="bf16" stores blocks in
+        # that dtype; "int8" stores int8 blocks + a per-(position, head)
+        # f32 scale leaf, quantized on write and dequantized on read
         floats = [l for l in jax.tree.leaves(params)
                   if jnp.issubdtype(l.dtype, jnp.floating)]
-        dtype = floats[0].dtype if floats else jnp.float32
+        self._cdtype = floats[0].dtype if floats else jnp.float32
+        self.kv_dtype = kv_dtype
+        dtype = jnp.int8 if kv_dtype == "int8" else self._cdtype
         # block size: a multiple of the in-place Pallas slot write's
-        # window so the paged write keeps the one-window-DMA fast path;
-        # t_max rounds up to whole blocks (ADVICE r5's alignment move,
-        # now at block granularity — observationally free, the per-row
-        # position mask stops at each row's live position)
+        # window so the paged write keeps the one-window-DMA fast path
+        # (int8 tiles need 32 sublanes — _window knows); t_max rounds up
+        # to whole blocks (ADVICE r5's alignment move, now at block
+        # granularity — observationally free, the per-row position mask
+        # stops at each row's live position)
         align = _window(dtype)
         bt = kv_block_tokens if kv_block_tokens is not None else align
         self.bt = -(-bt // align) * align
@@ -589,10 +614,18 @@ class ContinuousBatcher:
 
         # per-layer block POOLS [2(k/v), P, hk, bt, hd]: each tick's
         # write is one window DMA per row through the block table
-        # (ops/pallas/cache_update.py::kv_pool_insert_rows_pallas)
+        # (ops/pallas/cache_update.py::kv_pool_insert_rows_pallas).
+        # int8 pools carry a "scale" leaf [2, P, hk, bt, 1] beside
+        # "kv", sharded identically (the last two axes are unsharded in
+        # _POOL_SPEC, so the narrower leaf reuses the spec) — every
+        # consumer of the pool dict (attention ops, COW copies,
+        # reset/reconstruct zeroing) treats the leaves generically.
         self._caches = [
             {"kv": dev(jnp.zeros((2, pool_blocks, hk, self.bt, hd), dtype),
-                       _POOL_SPEC)}
+                       _POOL_SPEC),
+             **({"scale": dev(jnp.zeros((2, pool_blocks, hk, self.bt, 1),
+                                        jnp.float32), _POOL_SPEC)}
+                if kv_dtype == "int8" else {})}
             for _ in range(n_layers)]
         if (jax.default_backend() == "tpu"
                 and (mesh is not None
@@ -622,12 +655,17 @@ class ContinuousBatcher:
         self._tier_promote_t0 = None
         if _tier_on:
             np_dtype = np.dtype(dtype)
+            scale_isz = 4 if kv_dtype == "int8" else 0
             hb = (host_cache_blocks if host_cache_blocks is not None
                   else host_blocks_for_mb(host_cache_mb, n_layers, hk,
-                                          self.bt, hd, np_dtype.itemsize))
+                                          self.bt, hd, np_dtype.itemsize,
+                                          scale_itemsize=scale_isz))
             self._tier = KVTierManager(
                 self._radix,
-                HostBlockPool(hb, n_layers, hk, self.bt, hd, np_dtype),
+                HostBlockPool(hb, n_layers, hk, self.bt, hd, np_dtype,
+                              scale_dtype=(np.float32
+                                           if kv_dtype == "int8"
+                                           else None)),
                 DiskTier(disk_cache_dir, async_writes=True)
                 if disk_cache_dir else None)
         # per-row slot of the last written token (host-tracked: admission
@@ -672,9 +710,19 @@ class ContinuousBatcher:
         # crash durability (cold prefill only for what disk lost)
         if self._tier is not None and self._tier.disk is not None:
             np_dtype = np.dtype(dtype)
-            self._tier.adopt_disk_index(
-                lambda n: ((n_layers, 2, -(-n // self.bt), hk, self.bt,
-                            hd), str(np_dtype)))
+            if kv_dtype == "int8":
+                # int8 shards must also match the scale geometry — a
+                # bf16 engine refuses int8 shards and vice versa (the
+                # 2-tuple form carries no scale expectation)
+                self._tier.adopt_disk_index(
+                    lambda n: ((n_layers, 2, -(-n // self.bt), hk,
+                                self.bt, hd), str(np_dtype),
+                               (n_layers, 2, -(-n // self.bt), hk,
+                                self.bt, 1), "float32"))
+            else:
+                self._tier.adopt_disk_index(
+                    lambda n: ((n_layers, 2, -(-n // self.bt), hk,
+                                self.bt, hd), str(np_dtype)))
         # moe_capacity is STATIC: capacity shapes the routing one-hots, so
         # each distinct (wave size, wave-max capacity) pair compiles its
         # own admission program; per-row capacities ride along as a
@@ -698,6 +746,7 @@ class ContinuousBatcher:
         # no borrowers frees with its last user.
         try:
             key = (type(self.model), self.model.config, self.bt, self.S,
+                   self.kv_dtype,
                    None if mesh is None else
                    (tuple(mesh.devices.flat), tuple(mesh.axis_names)))
             hash(key)
@@ -812,6 +861,26 @@ class ContinuousBatcher:
              **({} if _jr is None else dict(_jr.stats))})
         if _jr is not None:
             _jr.stats = self.journal
+        # quantized-KV attribution (ISSUE 16): blocks living int8 in
+        # the pool, dispatches that dequantized a gathered read, bytes
+        # the int8 layout saved against the bf16 one (HBM computed once
+        # from the actual cache geometry; D2H/handoff accumulated per
+        # move), greedy mismatches harvested by the bf16-vs-int8 A/B
+        # (record_greedy_mismatch — the relaxed parity contract's
+        # forensic counter), and handoffs declined for a dtype mismatch
+        self.kvq = obs_metrics.MetricDict(self.obs, "serve.kvq.", {
+            "quantized_blocks": 0, "dequant_reads": 0,
+            "bytes_saved_hbm": 0, "bytes_saved_d2h": 0,
+            "bytes_saved_handoff": 0, "greedy_mismatches": 0,
+            "handoff_dtype_declined": 0})
+        if getattr(self, "kv_dtype", "bf16") == "int8":
+            saved = 0
+            for c in self._caches:
+                kv = c["kv"]
+                # the bf16 pool would spend 2 bytes where int8 spends
+                # 1, minus what the f32 scales give back
+                saved += kv.size * 2 - kv.size - c["scale"].size * 4
+            self.kvq["bytes_saved_hbm"] = saved
         self.last_host_block_leaks = 0  # host blocks unaccounted at exit
         # per-request SLO distributions (serve_lifecycle.RequestResult
         # field docs define the measurement points); seconds, log
@@ -835,6 +904,7 @@ class ContinuousBatcher:
             "tier": dict(self.tier),
             "prefill": dict(self.prefill),
             "journal": dict(self.journal),
+            "kvq": dict(self.kvq),
             "slo": {name: h.summary() for name, h in self._slo.items()},
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
@@ -876,7 +946,12 @@ class ContinuousBatcher:
         READ-ONLY: device entries are peeked D2H, demoted entries read
         without releasing their tier copy. None = nothing to export
         (cache off, no match, or a disk part failing CRC) — the caller
-        falls back to token-identical replay."""
+        falls back to token-identical replay.
+
+        int8 pools export their scale arrays beside the blocks
+        (``"scale"`` + its own ``"scale_crc"`` stamp) and stamp the
+        pool dtype (``"kv_dtype"``) so a mixed-dtype import declines
+        to replay instead of landing bytes it cannot read."""
         if self._radix is None or len(tokens) < 2:
             return None
         head = list(tokens)[:-1]
@@ -890,26 +965,47 @@ class ContinuousBatcher:
             return None
         k = -(-m // self.bt)
         if entry is None:                   # tier-off: device blocks
-            content = np.stack(
-                [np.asarray(c["kv"][:, jnp.asarray(blocks[:k],
-                                                   jnp.int32)])
-                 for c in self._caches])
+            content = self._peek_blocks(blocks[:k])
         elif entry.tier == TIER_DEVICE:
-            content = np.stack(
-                [np.asarray(c["kv"][:, jnp.asarray(entry.blocks[:k],
-                                                   jnp.int32)])
-                 for c in self._caches])
+            content = self._peek_blocks(entry.blocks[:k])
         elif entry.tier == TIER_HOST:
             content = self._tier.host.read(entry.host_blocks[:k])
         else:                               # TIER_DISK
             got, _corrupt = self._tier.disk.get(entry.disk_key)
             if got is None:
                 return None                 # CRC miss: caller replays
-            content = got[:, :, :k]
+            content = {name: leaf[:, :, :k]
+                       for name, leaf in self._as_content(got).items()}
+        content = self._as_content(content)
+        kv = content["kv"]
+        total = sum(int(leaf.nbytes) for leaf in content.values())
         self.prefill["handoff_exports"] += 1
-        self.prefill["handoff_bytes"] += int(content.nbytes)
-        return {"tokens": tuple(head[:m]), "n_tokens": m,
-                "kv": content, "crc": _crc(content), "bt": self.bt}
+        self.prefill["handoff_bytes"] += total
+        payload = {"tokens": tuple(head[:m]), "n_tokens": m,
+                   "kv": kv, "crc": _crc(kv), "bt": self.bt,
+                   "kv_dtype": self.kv_dtype}
+        if "scale" in content:
+            payload["scale"] = content["scale"]
+            payload["scale_crc"] = _crc(content["scale"])
+            # the bf16 payload would be 2 bytes/element of kv alone
+            self.kvq["bytes_saved_handoff"] += int(kv.nbytes) * 2 - total
+        return payload
+
+    def _peek_blocks(self, blocks) -> dict:
+        """D2H peek of pool ``blocks`` across every layer/leaf:
+        ``{"kv": [L, 2, n, hk, bt, hd]}`` plus ``"scale"`` for int8
+        pools — the tier/handoff content dict."""
+        idx = jnp.asarray(blocks, jnp.int32)
+        return {name: np.stack([np.asarray(c[name][:, idx])
+                                for c in self._caches])
+                for name in self._caches[0]}
+
+    @staticmethod
+    def _as_content(content) -> dict:
+        """Normalise tier/handoff content: a bare array is the legacy
+        bf16 ``kv``-only form, a dict carries scales beside it."""
+        return (content if isinstance(content, dict)
+                else {"kv": content})
 
     def import_prefix(self, payload) -> bool:
         """HANDOFF IMPORT: land an :meth:`export_prefix` payload in
@@ -918,38 +1014,67 @@ class ContinuousBatcher:
         bytes register as a demoted entry (zero device blocks now; the
         existing PR 13 promotion scatters them H2D on first match);
         tier-less they scatter straight into freshly allocated pool
-        blocks. False = declined — CRC/shape/layout mismatch or pool
-        pressure — and nothing changed: the caller's token-identical
-        replay fallback costs only the compute the handoff would have
-        saved."""
+        blocks. False = declined — CRC/shape/layout/dtype mismatch or
+        pool pressure — and nothing changed: the caller's
+        token-identical replay fallback costs only the compute the
+        handoff would have saved.
+
+        The geometry check covers the SCALE arrays too (ISSUE 16): an
+        int8 pool requires a well-shaped ``"scale"`` whose
+        ``"scale_crc"`` verifies, a bf16 pool refuses any payload
+        carrying one, and a ``"kv_dtype"`` stamp mismatch declines
+        with its own counter (``serve.kvq.handoff_dtype_declined``) —
+        every mismatch declines to replay, never raises."""
         if self._radix is None or not payload:
             return False
+        if payload.get("kv_dtype", "bf16") != self.kv_dtype:
+            # prefill and decode tiers must agree on the pool dtype —
+            # int8 bytes are unreadable without this pool's dequant
+            # convention and vice versa (cli_serve validates the fleet;
+            # this guards cross-process handoffs)
+            self.kvq["handoff_dtype_declined"] += 1
+            self.prefill["handoff_declined"] += 1
+            return False
         kv = payload.get("kv")
+        scale = payload.get("scale")
         n = int(payload.get("n_tokens", 0))
         toks = tuple(payload.get("tokens", ()))
         cache = self._caches[0]["kv"]
-        want = (len(self._caches), 2, -(-n // self.bt),
-                cache.shape[2], self.bt, cache.shape[4])
+        k = -(-n // self.bt)
+        want = (len(self._caches), 2, k, cache.shape[2], self.bt,
+                cache.shape[4])
+        swant = (len(self._caches), 2, k, cache.shape[2], self.bt, 1)
         if (kv is None or n < 1 or len(toks) != n
                 or payload.get("bt") != self.bt
                 or tuple(kv.shape) != want
-                or payload.get("crc") != _crc(kv)):
+                or payload.get("crc") != _crc(kv)
+                or (self.kv_dtype == "int8"
+                    and (scale is None or tuple(scale.shape) != swant
+                         or payload.get("scale_crc") != _crc(scale)))
+                or (self.kv_dtype != "int8" and scale is not None)):
             self.prefill["handoff_declined"] += 1
             return False
+        content = {"kv": np.asarray(kv)}
+        if scale is not None:
+            content["scale"] = np.asarray(scale)
+        total = sum(int(leaf.nbytes) for leaf in content.values())
         if self._tier is not None:
             entry = self._radix.insert_demoted(toks)
             if entry is None:      # already cached here: a handoff hit
                 self.prefill["handoff_imports"] += 1
                 return True
-            if self._tier.store(entry, np.asarray(kv)):
+            if self._tier.store(entry, content if scale is not None
+                                else content["kv"]):
                 self.prefill["handoff_imports"] += 1
-                self.prefill["handoff_bytes"] += int(kv.nbytes)
+                self.prefill["handoff_bytes"] += total
+                if scale is not None:
+                    self.kvq["bytes_saved_handoff"] += (
+                        int(kv.nbytes) * 2 - total)
                 return True
             # no host room even after spilling: drop the placeholder
             # (a tier-less entry left in the tree would crash a later
             # fetch) and fall through to the direct-device path
             self._tier._remove(entry)
-        k = -(-n // self.bt)
         try:
             blocks = self._alloc(k)
         except PoolExhausted:
@@ -958,15 +1083,80 @@ class ContinuousBatcher:
         with self._mesh_ctx():
             self._caches = self._promote_c(
                 self._caches, jnp.asarray(blocks, jnp.int32),
-                jnp.asarray(kv))
+                {name: jnp.asarray(leaf)
+                 for name, leaf in content.items()})
         # the tree owns the refs from here; drop the alloc's. insert
         # returning False (exact duplicate raced in) release the blocks
         # to garbage — harmless, they are free and unreferenced
         self._radix.insert(toks, blocks)
         self._pool.release(blocks)
         self.prefill["handoff_imports"] += 1
-        self.prefill["handoff_bytes"] += int(kv.nbytes)
+        self.prefill["handoff_bytes"] += total
+        if scale is not None:
+            self.kvq["bytes_saved_handoff"] += int(kv.nbytes) * 2 - total
         return True
+
+    def logit_probe(self, tokens) -> np.ndarray:
+        """Teacher-forced per-position logits ``[n, V]`` (f32) for
+        ``tokens``, computed through a SCRATCH one-row paged pool in
+        THIS engine's KV dtype — token ``i`` embeds at logical count
+        ``i`` and writes/attends at slot ``i``, the exact (position,
+        count) pairs serving uses, through the same fused
+        quantize-on-write / dequantize-on-read block route. The bench
+        A/B (``--serve-kvq-smoke``) runs the probe on a bf16 and an
+        int8 engine over the same stream and records the per-position
+        KL — the bounded-error half of the relaxed parity contract.
+        The live pool is untouched (scratch blocks, scratch table);
+        under a mesh the scratch runs replicated."""
+        toks = [int(t) for t in tokens]
+        n = len(toks)
+        if n == 0:
+            return np.zeros((0, 0), np.float32)
+        nbp = -(-n // self.bt)
+        scratch = [{name: jnp.zeros(
+                        (leaf.shape[0], nbp) + tuple(leaf.shape[2:]),
+                        leaf.dtype)
+                    for name, leaf in c.items()} for c in self._caches]
+        table = jnp.arange(nbp, dtype=jnp.int32)[None, :]
+        model = self.model
+
+        def step(params, caches, tok, pos):
+            x = model.embed(params, tok[:, None], pos[:, None])
+            new_caches = []
+            for li in range(self._n_layers):
+                p_l = jax.tree.map(lambda a: a[li], params["blocks"])
+                paged = {**caches[li], "table": table}
+                x, c2 = self._block.decode_step(p_l, x, paged, pos)
+                new_caches.append({name: leaf
+                                   for name, leaf in c2.items()
+                                   if name != "table"})
+            return new_caches, model.readout(params, x)[:, -1]
+
+        step_c = jax.jit(step)
+        out = []
+        with self._mesh_ctx():
+            for i, t in enumerate(toks):
+                scratch, logits = step_c(
+                    self.params, scratch, jnp.asarray([t], jnp.int32),
+                    jnp.asarray([i], jnp.int32))
+                out.append(np.asarray(logits[0], jnp.float32))
+        return np.stack(out)
+
+    def record_greedy_mismatch(self, position: int, expected: int,
+                               got: int, stream: str = "") -> None:
+        """Bench A/B hook: one bf16-vs-int8 greedy divergence at
+        ``position`` of ``stream``. Bumps
+        ``serve.kvq.greedy_mismatches`` and drops a flight-recorder
+        instant so every mismatch harvested during the A/B is
+        post-mortem visible (ISSUE 16 satellite) — the smoke gate is
+        rate-based (>=99% match), so individual mismatches are
+        expected, recorded, and bounded, not fatal."""
+        self.kvq["greedy_mismatches"] += 1
+        instant("kvq_greedy_mismatch", position=int(position),
+                expected=int(expected), got=int(got), stream=str(stream))
+        flight.record("kvq_greedy_mismatch", position=int(position),
+                      expected=int(expected), got=int(got),
+                      stream=str(stream))
 
     def profile_next(self, segments: int, profile_dir: str) -> None:
         """Arm ON-DEMAND XLA profiling: the next ``segments``
@@ -1059,9 +1249,18 @@ class ContinuousBatcher:
             if Lp:
                 # attached-prefix K/V: gathered from the pool and
                 # resharded into the row-sharded compute layout (the
-                # portable-redistribution move)
+                # portable-redistribution move). int8 pools dequantize
+                # here — the kv_prefix seam concatenates with the
+                # suffix's float K/V (models/transformer.py::
+                # _concat_kv_prefix), so the scales must be applied
+                # before the prefix leaves the pool's dtype domain
                 pk = gather_kv_blocks(caches[i]["kv"],
                                       tables[:, :Lp // self.bt])
+                if "scale" in caches[i]:
+                    ps = gather_kv_blocks(caches[i]["scale"],
+                                          tables[:, :Lp // self.bt])
+                    pk = (pk.astype(jnp.float32) * ps).astype(
+                        self._cdtype)
                 pk = constrain(pk, _CACHE_SPEC)
                 kw["kv_prefix"] = (pk[0], pk[1], prefix_mask)
             if self._block_takes_positions:
@@ -1078,10 +1277,28 @@ class ContinuousBatcher:
             if isinstance(x, tuple):   # MoE blocks return (x, aux)
                 x = x[0]
             (k, v), = sink             # [K, hk, ws, hd] — suffix only
-            kv = jnp.stack([k, v]).astype(caches[i]["kv"].dtype)
             # scatter each suffix token to its physical (block, offset):
             # advanced indices at pool axes (1, 3) land broadcast-first,
-            # so the update region is [K, ws, 2, hk, hd]
+            # so the update region is [K, ws, 2, hk, hd]. int8 pools
+            # quantize per (row, head, position) HERE — fused into the
+            # admission scatter, the same per-row symmetric form the
+            # decode tick's write uses (ops/attention.py) — and scatter
+            # the f32 scales through the identical index targets.
+            if "scale" in caches[i]:
+                kq, ks = quantize_kv(k)
+                vq, vs = quantize_kv(v)
+                kv = jnp.stack([kq, vq])         # [2, K, hk, ws, hd]
+                sc = jnp.stack([ks, vs])         # [2, K, hk, ws, 1]
+                new = caches[i]["kv"].at[
+                    :, blk_idx, :, off_idx, :].set(
+                        kv.transpose(1, 3, 0, 2, 4), mode="drop")
+                news = caches[i]["scale"].at[
+                    :, blk_idx, :, off_idx, :].set(
+                        sc.transpose(1, 3, 0, 2, 4), mode="drop")
+                new_caches.append({"kv": constrain(new, _POOL_SPEC),
+                                   "scale": constrain(news, _POOL_SPEC)})
+                continue
+            kv = jnp.stack([k, v]).astype(caches[i]["kv"].dtype)
             upd = kv.transpose(1, 3, 0, 2, 4)
             new = caches[i]["kv"].at[:, blk_idx, :, off_idx, :].set(
                 upd, mode="drop")
@@ -1103,19 +1320,23 @@ class ContinuousBatcher:
         return out
 
     def _promote_impl(self, caches, dst, payload):
-        """Hierarchical-KV promotion: host-tier K/V ``payload
-        [L, 2, M, hk, bt, hd]`` restored into pool blocks ``dst [M]``
-        across every layer, one compiled dispatch per promoted entry.
-        Under a mesh the payload arrives replicated (it was host
-        bytes) and the constrain lands it straight in the block-axis-
-        sharded pool layout — the same portable-redistribution move
-        admission-prefill K/V rides (``_admit_impl``), so each device
-        keeps only its own block shards."""
+        """Hierarchical-KV promotion: host-tier K/V ``payload`` — a
+        dict of per-leaf stacks (``{"kv": [L, 2, M, hk, bt, hd]}``,
+        plus ``"scale": [L, 2, M, hk, bt, 1]`` for int8 pools) —
+        restored into pool blocks ``dst [M]`` across every layer, one
+        compiled dispatch per promoted entry. Quantized bytes promote
+        AS-IS (no requantization round trip: demote→promote is
+        bit-exact on the int8 payload). Under a mesh the payload
+        arrives replicated (it was host bytes) and the constrain lands
+        it straight in the block-axis-sharded pool layout — the same
+        portable-redistribution move admission-prefill K/V rides
+        (``_admit_impl``), so each device keeps only its own block
+        shards."""
         out = []
         for i, c in enumerate(caches):
-            upd = payload[i].astype(c["kv"].dtype)
-            out.append({"kv": constrain(
-                c["kv"].at[:, dst].set(upd), _POOL_SPEC)})
+            out.append({name: constrain(
+                leaf.at[:, dst].set(payload[name][i].astype(leaf.dtype)),
+                _POOL_SPEC) for name, leaf in c.items()})
         return out
 
     def _segment_impl(self, params, caches, tables, tok, n_logical,
@@ -1232,6 +1453,8 @@ class ContinuousBatcher:
             self._radix.evict_for(
                 n, on_evict=(self._tier_demote if self._tier is not None
                              else None))
+        if self.kv_dtype == "int8":
+            self.kvq["quantized_blocks"] += n
         return self._pool.alloc(n)
 
     def _tier_demote(self, entry, doomed) -> bool:
@@ -1241,11 +1464,16 @@ class ContinuousBatcher:
         hook's contract — the WHOLE entry is captured, because a
         shared block's device copy survives only as long as its
         sharing row does, while the demoted entry must outlive both.
-        Truthy return = entry demoted in place of discarded."""
-        content = np.stack(
-            [np.asarray(c["kv"][:, jnp.asarray(entry.blocks, jnp.int32)])
-             for c in self._caches])
-        return self._tier.store(entry, content)
+        Truthy return = entry demoted in place of discarded. Int8
+        pools demote the quantized bytes plus scales — roughly half
+        the bf16 D2H traffic, counted in ``serve.kvq``."""
+        content = self._peek_blocks(entry.blocks)
+        if "scale" in content:
+            self.kvq["bytes_saved_d2h"] += (
+                int(content["kv"].nbytes) - int(content["scale"].nbytes))
+            return self._tier.store(entry, content)
+        # legacy bf16 form: bare kv stack, tier stores it unchanged
+        return self._tier.store(entry, content["kv"])
 
     def _promote_entry(self, entry) -> bool:
         """Restore a demoted entry's K/V to the device pool: allocate
@@ -1270,11 +1498,13 @@ class ContinuousBatcher:
         if content is None:                  # disk CRC miss: entry gone
             self._pool.release(blocks)
             return False
+        content = self._as_content(content)
         t0 = time.monotonic()
         with self._mesh_ctx():
             self._caches = self._promote_c(
                 self._caches, jnp.asarray(blocks, jnp.int32),
-                jnp.asarray(content))
+                {name: jnp.asarray(leaf)
+                 for name, leaf in content.items()})
         entry.blocks = blocks                # the tree now owns the refs
         entry.tier = TIER_DEVICE
         self.tier["promotions"] += 1
@@ -2047,6 +2277,10 @@ class ContinuousBatcher:
                 self._row_pos[b] += self.S
             self.ticks += self.S
             self.stats["segments"] += 1
+            if self.kv_dtype == "int8":
+                # every decode tick gathers + dequantizes the row's
+                # resident blocks inside the fused attend
+                self.kvq["dequant_reads"] += 1
             for b, ri, take, _ in plan:
                 table[b].remaining -= take
                 ticks_charged[ri] += take
@@ -2165,6 +2399,8 @@ class ContinuousBatcher:
             self.ticks += W
             self.stats["segments"] += 1
             self.spec["verify_segments"] += 1
+            if self.kv_dtype == "int8":
+                self.kvq["dequant_reads"] += 1
             for _b, _ri, _d in plan:
                 self.waste["planned_ticks"] += W
             if chaos is not None and chaos.on_segment is not None:
@@ -2614,6 +2850,10 @@ class ContinuousBatcher:
                 if self._block_takes_moe_capacity_rows:
                     kw["moe_capacity_rows"] = jnp.asarray(
                         caps + [1] * (Kp - K), jnp.int32)
+            if self.kv_dtype == "int8" and Lp > 0:
+                # attached-prefix gather dequantizes int8 blocks inside
+                # the admission forward (see _admit_impl)
+                self.kvq["dequant_reads"] += 1
             with span("prefill_wave", rows=len(entries)), \
                     self._mesh_ctx():
                 self._caches = self._admit_c(

@@ -187,6 +187,18 @@ class ServeJournal:
                       "deadline_s": deadline_s,
                       "emitted": [int(t) for t in emitted]})
 
+    def config(self, obj: dict) -> None:
+        """Process-config frame (ISSUE 16): the serving configuration
+        whose mismatch across a restart would silently change recovered
+        streams — today the pool ``kv_dtype`` (int8 emitted tokens are
+        not bit-promises a bf16 pool can keep, and vice versa).
+        Written once, right after the journal opens; ``recover()``
+        surfaces the LAST one in ``RecoveryManifest.config`` and
+        ``cli_serve`` refuses a mismatched restart with a one-line
+        error instead of replaying sessions under a different
+        numeric contract."""
+        self._append({"kind": "config", "config": dict(obj)})
+
     def delta(self, rid: str, tokens) -> None:
         """Per-harvest emitted-token frame: ``tokens`` reached the
         host this harvest (post-eos-trim — only delivered tokens)."""
@@ -259,6 +271,9 @@ class RecoveryManifest:
     frames: int = 0
     torn_bytes: int = 0
     path: str | None = None
+    # the last journaled config frame (None = pre-ISSUE 16 journal):
+    # restart validation compares it against the requested flags
+    config: dict | None = None
 
     @property
     def completed(self) -> dict:
@@ -278,6 +293,9 @@ def recover(root: str) -> RecoveryManifest:
 
     Per-id replay rules:
 
+    - a ``config`` frame carries process-level serving config (pool
+      ``kv_dtype``); the last one lands in ``manifest.config`` and
+      restart validation compares it against the requested flags;
     - a LATER admit frame whose prompt EXTENDS the session's prompt is
       a continuation re-admission (crash replay, or a router
       migration's prompt+partial sub-request): the extension tokens
@@ -295,9 +313,17 @@ def recover(root: str) -> RecoveryManifest:
     torn = _repair_tail(path, stats)
     frames, _end, _size = _scan(path)
     sessions: dict[str, JournalSession] = {}
+    config: dict | None = None
     for f in frames:
         rid = f.get("id")
         kind = f.get("kind")
+        if kind == "config":
+            # process-level frame, no request id: the LAST one wins
+            # (a restart that passed validation re-journals its own)
+            c = f.get("config")
+            if isinstance(c, dict):
+                config = c
+            continue
         if not isinstance(rid, str):
             continue
         s = sessions.get(rid)
@@ -334,7 +360,8 @@ def recover(root: str) -> RecoveryManifest:
             s.status = f.get("status")
             s.error = f.get("error")
     manifest = RecoveryManifest(sessions=sessions, frames=len(frames),
-                                torn_bytes=torn, path=path)
+                                torn_bytes=torn, path=path,
+                                config=config)
     if sessions:
         instant("journal_recover",
                 sessions=len(sessions),

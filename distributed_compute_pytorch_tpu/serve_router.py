@@ -229,6 +229,18 @@ class ServeRouter:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
         n = len(self.replicas)
+        # the fleet must agree on the KV pool dtype (ISSUE 16): a
+        # handoff/migration between an int8 and a bf16 replica would
+        # decline every payload (import_prefix's kv_dtype stamp), so a
+        # mixed fleet silently degrades every migration to full replay
+        # — refuse it at construction instead. Prefill and decode
+        # tiers are both replicas here, so this covers the
+        # disagg-prefill seam too.
+        dts = {getattr(r, "kv_dtype", "bf16") for r in self.replicas}
+        if len(dts) > 1:
+            raise ValueError(
+                f"all replicas must share one kv_dtype, got {sorted(dts)}")
+        self.kv_dtype = next(iter(dts))
         # disaggregated prefill: replicas [0, prefill_replicas) form the
         # prefill tier — sessions placed there always migrate to a
         # decode replica right after their prompt finishes prefilling,
