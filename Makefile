@@ -26,7 +26,8 @@ SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
 	serve-tier-smoke serve-spec-smoke serve-kvq-smoke serve-load-smoke \
-	serve-router-smoke serve-disagg-smoke serve-journal-smoke bench-diff
+	serve-router-smoke serve-disagg-smoke serve-journal-smoke \
+	serve-width-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -114,6 +115,14 @@ bench:
 #   run's tokens are identical to an unkilled reference, >= 1 session
 #   resumed from journaled state, nothing leaks, and the journal-on
 #   decode-tick p99 stays within 1.25x of journal-off (best of 3)
+# - serve-width: the width-bucketed paged-decode drill — a mixed
+#   Poisson stream (short chatty sessions + one deep anchor climbing
+#   the rung ladder) served with bucketing off (one full-horizon
+#   program) and on; fails unless tokens are identical on vs off
+#   (greedy + sampled rows), the bucketed run gathers at least 2x
+#   fewer KV blocks than the full-width equivalent, decode-tick p99
+#   stays within 1.25x of full-width (best of 3), compiled programs stay bounded
+#   by the ladder, >= 1 bucket growth fires, and nothing leaks
 # - bench-diff (last): the regression gate's self-test — one smoke's
 #   record diffed against itself through obs/regress.py must pass
 #   (a gate that flags identical runs is broken)
@@ -130,6 +139,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-journal-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-width-smoke
 	$(MAKE) bench-diff
 
 # the bench-regression gate (obs/regress.py): BASE/NEW default to a
@@ -171,3 +181,6 @@ serve-disagg-smoke:
 
 serve-journal-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-journal-smoke
+
+serve-width-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-width-smoke

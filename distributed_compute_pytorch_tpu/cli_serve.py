@@ -263,6 +263,24 @@ def main(argv=None) -> int:
                         "streams — see DESIGN.md 'Quantized KV'). "
                         "Replicas inherit; a journaled run refuses to "
                         "recover under a different kv_dtype")
+    p.add_argument("--decode_width_buckets", type=int, default=None,
+                   help="width-bucket ladder depth (ISSUE 19): decode/"
+                        "verify dispatches slice the block tables to "
+                        "the smallest power-of-two rung covering the "
+                        "live working set, so per-tick KV gather "
+                        "traffic tracks live tokens instead of t_max. "
+                        "Default: the full ladder; N keeps only the "
+                        "widest N rungs (1 = a single full-horizon "
+                        "bucket, i.e. bucketing off). Outputs are "
+                        "token-identical at any setting")
+    p.add_argument("--prewarm_widths", action="store_true",
+                   help="compile every width-bucket rung's decode "
+                        "program at startup (and again after each "
+                        "--supervise respawn, which re-runs this "
+                        "entrypoint), so the first long session never "
+                        "eats a mid-traffic XLA compile when its "
+                        "bucket grows; counted in "
+                        "serve.width.prewarmed_programs")
     p.add_argument("--mesh", default=None,
                    help="mesh spec for SHARDED serving (e.g. "
                         "data=2,tensor=2): cache rows shard over the "
@@ -446,6 +464,10 @@ def main(argv=None) -> int:
     if args.prefill_chunk_tokens is not None \
             and args.prefill_chunk_tokens < 1:
         raise SystemExit("--prefill_chunk_tokens must be >= 1")
+    if args.decode_width_buckets is not None \
+            and args.decode_width_buckets < 1:
+        raise SystemExit("--decode_width_buckets must be >= 1 "
+                         "(1 = a single full-horizon bucket)")
     if args.prefill_chunk_tokens is not None and args.model == "moe":
         raise SystemExit("--prefill_chunk_tokens is not supported for "
                          "--model moe (expert routing is group-"
@@ -631,7 +653,8 @@ def main(argv=None) -> int:
             speculate=args.speculate or None,
             prefill_chunk_tokens=args.prefill_chunk_tokens,
             journal=journal,
-            kv_dtype=args.kv_dtype)
+            kv_dtype=args.kv_dtype,
+            decode_width_buckets=args.decode_width_buckets)
 
     router = None
     if args.replicas > 1:
@@ -642,6 +665,13 @@ def main(argv=None) -> int:
         cb = router.replicas[0]        # profile/SIGUSR1 target
     else:
         cb = build_batcher()
+
+    if args.prewarm_widths:
+        # one batcher warms the fleet: replicas share compiled programs
+        # through the _PROGRAM_CACHE donor, so each ladder rung compiles
+        # exactly once. A --supervise respawn re-enters this entrypoint
+        # and prewarms again — the restarted process's jit cache is cold
+        cb.prewarm_widths(sampling=args.temperature > 0)
 
     if args.profile_segments is not None:
         # on-demand window (first N segments now; SIGUSR1 re-arms). The

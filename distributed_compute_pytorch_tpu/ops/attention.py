@@ -286,16 +286,25 @@ def gather_kv_blocks(pool_leaf, table):
     -> ``[s, B, hk, nb * bt, hd]`` — row ``b``'s logical slot ``t`` is
     ``pool_leaf[:, table[b, t // bt], :, t % bt]``.
 
-    This is the portable-XLA paged read: the gather moves the same
-    bytes decode attention reads anyway (O(B * t_max) per layer per
-    tick), so the paged pool costs one extra HBM round trip vs the
-    dense per-row cache on current XLA:TPU — the block-table Pallas
-    decode kernel (``ops/pallas/decode_attention.py``,
-    ``block_tables=``) is the reference for folding the table lookup
-    into the stream itself. Under a mesh the gather's OUTPUT is
-    constrained to the row-sharded decode layout by the caller, so
-    attached blocks reshard into it via whatever collective the two
-    layouts imply (the arXiv:2112.01075 redistribution move)."""
+    This is the portable-XLA paged read, and its traffic is set
+    ENTIRELY by the table argument: ``O(B * nb * bt)`` bytes per layer
+    per tick for whatever ``nb`` the caller ships. The serve scheduler
+    slices the host tables to the smallest bucket-ladder rung covering
+    the live working set (``serve.py``, ISSUE 19), so a tick's gather
+    moves bytes proportional to live tokens, NOT to ``t_max`` — the
+    old fixed-horizon cost model (every tick gathering ``t_max``
+    slots, mostly trash-block reads for short rows) only returns when
+    bucketing is off (``decode_width_buckets=1``) or a session
+    actually fills the horizon. The gather still costs one extra HBM
+    round trip vs the dense per-row cache on current XLA:TPU — the
+    block-table Pallas decode kernel
+    (``ops/pallas/decode_attention.py``, ``block_tables=``) is the
+    reference for folding the table lookup into the stream itself.
+    Under a mesh the gather's OUTPUT is constrained to the row-sharded
+    decode layout by the caller, so attached blocks reshard into it
+    via whatever collective the two layouts imply (the
+    arXiv:2112.01075 redistribution move) — a sliced table just
+    narrows the unsharded slot axis of that move."""
     g = pool_leaf[:, table]                    # [s, B, nb, hk, bt, hd]
     s, B, nb, hk, bt, hd = g.shape
     return g.transpose(0, 1, 3, 2, 4, 5).reshape(s, B, hk, nb * bt, hd)
@@ -309,7 +318,15 @@ def _paged_write_and_attend(q, k, v, cache, pos, *, slot_mask=None):
     then attends over its gathered logical view. The caller (the serve
     scheduler) guarantees the written block is exclusively owned —
     shared prefix blocks are copy-on-write BEFORE a row may write into
-    their span, so the write never mutates another row's reads."""
+    their span, so the write never mutates another row's reads.
+
+    The working-set WIDTH flows from the table: a ``[B, nb_w]`` slice
+    makes the gathered views, the position-validity masks, and the
+    ``slot_mask`` plumbing all ``nb_w * bt`` wide (including the int8
+    ``scale`` leaf, gathered through the same table). The caller must
+    ship a table covering ``max(pos) // bt`` — the write's
+    ``take_along_axis`` clamps, which is only correct for parked rows
+    whose table is all-trash."""
     from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
         kv_pool_insert_all)
     from distributed_compute_pytorch_tpu.utils.quantize import quantize_kv
@@ -361,7 +378,13 @@ def cache_verify_and_attend(q, k, v, cache, positions, *, slot_mask=None):
     bit-comparable to plain decode. Speculation is a pure read-side
     rollback: rejecting tokens only rewinds the host's per-row position,
     stale K/V beyond it is never attended and is overwritten by the next
-    verify. Returns ``(o [B, H, W, hd], new_cache)``."""
+    verify. Returns ``(o [B, H, W, hd], new_cache)``.
+
+    As everywhere in the paged path, the logical horizon is the
+    TABLE's: ``t_max = table.shape[1] * bt``. A width-bucketed caller
+    (serve.py, ISSUE 19) shipping a ``[B, nb_w]`` slice must pick a
+    rung covering ``max(positions) + 1`` slots, or an in-horizon write
+    would be sentinel-dropped as if it were past the row's extent."""
     from distributed_compute_pytorch_tpu.utils.quantize import quantize_kv
     table = cache["table"]
     pool = {n: leaf for n, leaf in cache.items() if n != "table"}

@@ -23,7 +23,11 @@ instead, with everything the TPU touches remaining static-shaped:
   longer own contiguous cache memory, which is what makes PREFIX
   SHARING possible at all. Parked/free rows point at the reserved
   trash block, where their per-tick garbage writes can never corrupt a
-  live or cached block.
+  live or cached block. Each dispatch ships the tables SLICED to the
+  smallest rung of a geometric width-bucket ladder covering the live
+  working set (``decode_width_buckets``; ISSUE 19), so per-tick KV
+  gather traffic tracks live tokens, not the horizon — one compiled
+  program per rung, token-identical at every width.
 - **Radix prefix cache** (``prefix_cache=True``): a host-side radix
   tree over prompt-HEAD tokens (``kv_pool.RadixCache``) maps a new
   request's longest cached prefix to already-prefilled blocks. The
@@ -415,7 +419,8 @@ class ContinuousBatcher:
                  journal=None,
                  journal_dir: str | None = None,
                  journal_fsync: str = "every_harvest",
-                 kv_dtype: str = "bf16"):
+                 kv_dtype: str = "bf16",
+                 decode_width_buckets: int | None = None):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -449,6 +454,11 @@ class ContinuousBatcher:
         if kv_dtype not in ("bf16", "int8"):
             raise ValueError(
                 f"kv_dtype must be 'bf16' or 'int8', got {kv_dtype!r}")
+        if decode_width_buckets is not None and decode_width_buckets < 1:
+            raise ValueError(
+                f"decode_width_buckets must be >= 1, got "
+                f"{decode_width_buckets} (1 = a single full-horizon "
+                f"bucket, i.e. width bucketing off)")
         _tier_on = (host_cache_mb is not None
                     or host_cache_blocks is not None
                     or disk_cache_dir is not None)
@@ -590,6 +600,29 @@ class ContinuousBatcher:
         self.bt = -(-bt // align) * align
         self.t_max = -(-t_max // self.bt) * self.bt
         self.nb = self.t_max // self.bt          # table entries per row
+        # width-bucket ladder (ISSUE 19): every decode/verify dispatch
+        # slices the shipped tables to the smallest rung (power-of-two
+        # multiples of bt, capped at nb) covering the live working set,
+        # so per-tick KV gather traffic tracks live tokens instead of
+        # the horizon. All gathered views, validity masks, and slot
+        # masks derive their width from the table argument
+        # (ops/attention.py), so the slice needs no op-side plumbing;
+        # the shared jit keys on the table aval, one compiled program
+        # per rung. decode_width_buckets keeps only the WIDEST k rungs
+        # (1 = full-horizon only, the pre-bucketing behaviour — the
+        # on/off A/B lever; outputs are token-identical either way
+        # because slots beyond a row's live extent are mask-invalid).
+        self.decode_width_buckets = decode_width_buckets
+        ladder, w = [], 1
+        while w < self.nb:
+            ladder.append(w)
+            w *= 2
+        ladder.append(self.nb)
+        if decode_width_buckets is not None:
+            ladder = ladder[-decode_width_buckets:]
+        self._width_ladder = tuple(ladder)
+        self._cur_width = self._width_ladder[0]
+        self._widths_dispatched: set = set()
         # chunked prefill: block-rounded per-WAVE suffix budget (the
         # chunk is the wave's static window, so rounding keeps the
         # scatter whole-block and the program count at ~one per mode)
@@ -637,6 +670,13 @@ class ContinuousBatcher:
                 "full-pool-copy scatter (~3x slower measured for the "
                 "dense analogue)",
                 stacklevel=2)
+        # HBM bytes ONE gathered block read moves per (row, layer):
+        # both K/V planes of every pool leaf (the int8 scale leaf
+        # rides along when present) — the unit behind
+        # serve.width.bytes_saved_vs_full
+        self._gather_block_bytes = sum(
+            leaf.nbytes // leaf.shape[1]
+            for leaf in self._caches[0].values())
         row_spec = P(("data", "fsdp"))
         self._cur_tok = dev(jnp.zeros((slots,), jnp.int32), row_spec)
         self._n_logical = dev(jnp.zeros((slots,), jnp.int32), row_spec)
@@ -729,7 +769,7 @@ class ContinuousBatcher:
         # traced [K] vector. Suffix/prefix window widths are static per
         # wave too — the prefix-cache-off path always compiles the one
         # prompt_buf-wide window, attach waves one program per
-        # block-rounded (suffix, prefix) pair.
+        # (block-rounded suffix, prefix bucket rung) pair.
         #
         # Compiled-PROGRAM sharing: jitting bound methods makes every
         # instance pay its own trace+compile even when an identical
@@ -747,6 +787,12 @@ class ContinuousBatcher:
         try:
             key = (type(self.model), self.model.config, self.bt, self.S,
                    self.kv_dtype,
+                   # the width-bucket knob: donors with different
+                   # ladders prewarm (and therefore cache) different
+                   # per-rung programs, so an on/off parity pair never
+                   # shares a donor by accident (each rung's program is
+                   # still keyed by the jit itself, on the table aval)
+                   self.decode_width_buckets,
                    None if mesh is None else
                    (tuple(mesh.devices.flat), tuple(mesh.axis_names)))
             hash(key)
@@ -881,6 +927,18 @@ class ContinuousBatcher:
                 # 1, minus what the f32 scales give back
                 saved += kv.size * 2 - kv.size - c["scale"].size * 4
             self.kvq["bytes_saved_hbm"] = saved
+        # width-bucket attribution (ISSUE 19): the rung each dispatch
+        # ran at (blocks) and how full it was, gathered block reads vs
+        # what the fixed full-horizon design would have issued (and the
+        # HBM bytes the difference saved), bucket GROWTHS (the only
+        # step that can eat a new compile mid-traffic — each one also
+        # drops a flight-recorder instant), and rungs compiled up front
+        # by prewarm_widths()
+        self.width = obs_metrics.MetricDict(self.obs, "serve.width.", {
+            "bucket_blocks": 0, "bucket_occupancy": 0.0,
+            "gathered_block_reads": 0, "full_width_block_reads": 0,
+            "bytes_saved_vs_full": 0, "bucket_growths": 0,
+            "prewarmed_programs": 0})
         self.last_host_block_leaks = 0  # host blocks unaccounted at exit
         # per-request SLO distributions (serve_lifecycle.RequestResult
         # field docs define the measurement points); seconds, log
@@ -905,6 +963,7 @@ class ContinuousBatcher:
             "prefill": dict(self.prefill),
             "journal": dict(self.journal),
             "kvq": dict(self.kvq),
+            "width": dict(self.width),
             "slo": {name: h.summary() for name, h in self._slo.items()},
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
@@ -1197,6 +1256,8 @@ class ContinuousBatcher:
         self._nlog_h[:] = 0
         self._spec_win = [0, 0]
         self._spec_on = self._spec is not None   # un-stick auto-disable
+        self._cur_width = self._width_ladder[0]
+        self._widths_dispatched.clear()
         self.ticks = 0
         self._zero_stats()
 
@@ -1586,36 +1647,130 @@ class ContinuousBatcher:
             return max_new
         return -(-max_new // self.S) * self.S
 
+    # ---- width buckets (ISSUE 19) ---------------------------------------
+
+    def _bucket_width(self, need_slots: int) -> int:
+        """Smallest bucket-ladder rung (a table width, in blocks) whose
+        horizon covers ``need_slots`` logical slots, capped at the full
+        table. Dispatch slices the shipped tables to this width; the
+        compiled program's gathered views and masks are rung-wide
+        because every attention-op width derives from the table
+        argument, and the shared jit keys on the table aval — so the
+        ladder bounds the compiled-program count."""
+        need = min(self.nb, -(-max(1, need_slots) // self.bt))
+        for w in self._width_ladder:
+            if w >= need:
+                return w
+        return self._width_ladder[-1]
+
+    def _note_width(self, nb_w: int, ticks: int, need_blocks: int) -> None:
+        """Per-dispatch width accounting: the rung chosen and how full
+        it ran, gathered-block traffic vs the fixed full-horizon
+        design (every pre-bucketing dispatch gathered all ``nb`` table
+        entries per row per layer per tick), and a flight-recorder
+        instant on every bucket GROWTH — growth is the only step that
+        can eat a new XLA compile mid-traffic, so each one must be
+        post-mortem visible."""
+        self._widths_dispatched.add(nb_w)
+        if nb_w > self._cur_width:
+            self.width["bucket_growths"] += 1
+            instant("width_bucket_growth",
+                    from_blocks=int(self._cur_width), to_blocks=int(nb_w))
+            flight.record("width_bucket_growth",
+                          from_blocks=int(self._cur_width),
+                          to_blocks=int(nb_w),
+                          segment=int(self.stats["segments"]))
+        self._cur_width = nb_w
+        self.width["bucket_blocks"] = nb_w
+        self.width["bucket_occupancy"] = need_blocks / nb_w
+        reads = self.B * nb_w * self._n_layers * ticks
+        full = self.B * self.nb * self._n_layers * ticks
+        self.width["gathered_block_reads"] += reads
+        self.width["full_width_block_reads"] += full
+        self.width["bytes_saved_vs_full"] += (
+            (full - reads) * self._gather_block_bytes)
+
+    def prewarm_widths(self, *, sampling: bool = False) -> int:
+        """Compile the decode-segment program for every bucket-ladder
+        rung NOW (``--prewarm_widths``): one dispatch per rung over
+        all-trash tables with every row parked at position 0, so the
+        first long request never eats a mid-traffic XLA compile when
+        its bucket grows. Rides the shared jit (and therefore the
+        ``_PROGRAM_CACHE`` donor), so a router fleet pays each rung
+        once; a ``--supervise`` respawn re-runs the CLI entrypoint and
+        prewarms again by construction. The throwaway ticks write only
+        into the reserved trash block and the device token/position
+        state is rewound afterwards, so a prewarmed batcher is
+        indistinguishable from a fresh one. Returns the number of
+        rungs dispatched (== programs compiled on a cold jit cache);
+        counted in ``serve.width.prewarmed_programs``."""
+        for w in self._width_ladder:
+            tables = np.full((self.B, w), BlockPool.TRASH, np.int32)
+            with span("prewarm_width", blocks=int(w)), self._mesh_ctx():
+                (self._caches, self._cur_tok, self._n_logical, _
+                 ) = self._segment_c(
+                    self.params, self._caches, jnp.asarray(tables),
+                    self._cur_tok, self._n_logical,
+                    jnp.asarray([0] * self.B, jnp.int32),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._seed),
+                    sampling=sampling)
+            self.width["prewarmed_programs"] += 1
+        # rewind the state the throwaway ticks advanced
+        self._caches = jax.tree.map(jnp.zeros_like, self._caches)
+        self._cur_tok = jnp.zeros_like(self._cur_tok)
+        self._n_logical = jnp.zeros_like(self._n_logical)
+        return len(self._width_ladder)
+
+    def _width_fraction(self) -> float:
+        """Cost weight of one decode tick HERE relative to a
+        full-horizon tick: the current bucket width over the full
+        table width. A tick's HBM traffic is dominated by the KV
+        gather, and the gather is rung-wide — so the router must price
+        a tick by the bucket it would actually run at, not by
+        ``t_max`` (the ISSUE 19 pricing fix: a replica serving short
+        sessions stops being priced as if every tick gathered the
+        horizon, and placement prefers replicas whose bucket stays
+        small)."""
+        return self._cur_width / self.nb
+
     def load_estimate(self, max_new: int) -> int:
         """Router-facing cost of serving ``max_new`` tokens here, in
-        device ticks (``serve_router`` load-balances on this): the
-        segment-rounded budget for plain decode; under LIVE speculation,
-        expected verify dispatches times the window width — each verify
-        costs ``k + 1`` tick-equivalents and emits ``1 + rate * k``
-        tokens in expectation, with the batcher's own measured
-        acceptance rate (0 until measured: admitting "speculation may
-        not pay" keeps cold estimates conservative)."""
+        FULL-WIDTH tick equivalents (``serve_router`` load-balances on
+        this): the segment-rounded budget for plain decode; under LIVE
+        speculation, expected verify dispatches times the window width
+        — each verify costs ``k + 1`` tick-equivalents and emits ``1 +
+        rate * k`` tokens in expectation, with the batcher's own
+        measured acceptance rate (0 until measured: admitting
+        "speculation may not pay" keeps cold estimates conservative).
+        Either tick count is then weighted by :meth:`_width_fraction`,
+        so a replica whose bucket stays small undercuts one already
+        gathering a long session's horizon."""
         if self._spec is None or not self._spec_on:
-            return -(-max_new // self.S) * self.S
-        rate = min(1.0, max(0.0, float(self.spec["acceptance_rate"])))
-        verifies = int(np.ceil(max_new / (1.0 + rate * self._spec.k)))
-        return max(verifies, 1) * self._spec_w
+            ticks = -(-max_new // self.S) * self.S
+        else:
+            rate = min(1.0, max(0.0, float(self.spec["acceptance_rate"])))
+            verifies = int(np.ceil(max_new / (1.0 + rate * self._spec.k)))
+            ticks = max(verifies, 1) * self._spec_w
+        return max(1, int(np.ceil(ticks * self._width_fraction())))
 
     def prefill_cost(self, suffix_tokens: int) -> int:
         """Router-facing cost of prefilling ``suffix_tokens`` uncached
         prompt tokens here, in the same tick units as
         :meth:`load_estimate`. Unchunked, a wave prefills the whole
         suffix in one stall — one token ≈ one tick of decode latency
-        stolen from the live rows. CHUNKED, the suffix spreads over
-        ``ceil(suffix / chunk)`` bounded waves, each riding one
-        decode-segment gap — so the placement cost is segments, not
-        tokens, and a long prompt no longer scares the load balancer
-        off a chunking replica (the ISSUE 14 pricing fix)."""
+        stolen from the live rows, independent of the decode bucket.
+        CHUNKED, the suffix spreads over ``ceil(suffix / chunk)``
+        bounded waves, each riding one decode-segment gap — the
+        placement cost is segments, not tokens (the ISSUE 14 pricing
+        fix), and each stalled segment is priced at the replica's
+        CURRENT bucket width like any other decode tick (ISSUE 19)."""
         if suffix_tokens <= 0:
             return 0
         if self._chunk is None:
             return suffix_tokens
-        return -(-suffix_tokens // self._chunk) * self.S
+        segs = -(-suffix_tokens // self._chunk) * self.S
+        return max(1, int(np.ceil(segs * self._width_fraction())))
 
     def _fits(self, req: Request) -> bool:
         return self.Tb + self._rounded_need(req.max_new) <= self.t_max
@@ -2249,6 +2404,17 @@ class ContinuousBatcher:
                     self.waste[key] += self.S
                     if table[b].pf_known is not None:
                         self.prefill["stall_ticks"] += self.S
+            # width bucket (ISSUE 19): the segment's S ticks write
+            # slots up to row_pos + S and attend nothing beyond, so
+            # the smallest rung covering max(live row_pos) + S + 1
+            # slots is exact — parked rows sit at 0 under all-trash
+            # tables (trash block id 0 is in-range at ANY width, and
+            # the paged write clamps), so the slice is safe for them
+            # at every rung
+            need = max(self._row_pos[b] for b in active) + self.S + 1
+            nb_w = self._bucket_width(need)
+            self._note_width(nb_w, self.S,
+                             min(self.nb, -(-need // self.bt)))
             prof = self._profile_req
             if prof is not None and not prof["active"]:
                 # profile_next() armed mid-run: open the XLA trace just
@@ -2259,7 +2425,8 @@ class ContinuousBatcher:
                 with self._mesh_ctx():
                     (self._caches, self._cur_tok, self._n_logical, toks
                      ) = self._segment_c(
-                        self.params, self._caches, jnp.asarray(tables_now),
+                        self.params, self._caches,
+                        jnp.asarray(tables_now[:, :nb_w]),
                         self._cur_tok, self._n_logical,
                         jnp.asarray(self._row_pos, jnp.int32),
                         jnp.asarray(self._temp), jnp.asarray(self._topk),
@@ -2372,6 +2539,16 @@ class ContinuousBatcher:
                     self.waste[key] += W
                     if table[b].pf_known is not None:
                         self.prefill["stall_ticks"] += W
+            # width bucket (ISSUE 19): a verify window writes slots
+            # row_pos+1 .. row_pos+W, and _verify_impl's beyond-horizon
+            # sentinel drops writes at positions >= nb_w * bt — so the
+            # rung MUST cover max(live row_pos) + W + 1 slots or an
+            # in-horizon accepted token would lose its K/V. Capped at
+            # nb, where the sentinel semantics match the full-width
+            # program exactly
+            need = max(self._row_pos[b] for b in active) + W + 1
+            nb_w = self._bucket_width(need)
+            self._note_width(nb_w, W, min(self.nb, -(-need // self.bt)))
             prof = self._profile_req
             if prof is not None and not prof["active"]:
                 jax.profiler.start_trace(prof["dir"])
@@ -2380,7 +2557,8 @@ class ContinuousBatcher:
                 with self._mesh_ctx():
                     self._caches, true = self._verify_c(
                         self.params, self._caches,
-                        jnp.asarray(tables_now), jnp.asarray(toks),
+                        jnp.asarray(tables_now[:, :nb_w]),
+                        jnp.asarray(toks),
                         jnp.asarray(self._row_pos, jnp.int32),
                         jnp.asarray(self._nlog_h),
                         jnp.asarray(self._temp), jnp.asarray(self._topk),
@@ -2788,11 +2966,14 @@ class ContinuousBatcher:
         ``window`` defaults to ``prompt_buf`` when no entry attaches
         (the one stable admission shape, exactly the pre-paged compile
         behaviour) and to the block-rounded longest suffix otherwise;
-        with CHUNKING on it is the chunk itself, and the prefix gather
-        spans the full table (``Lp = t_max``, garbage hidden by
-        ``prefix_mask``) — every chunk position compiles the same ~one
-        program instead of one per block-rounded (suffix, prefix)
-        pair. Reconstruction passes the width its grown prefixes need.
+        with CHUNKING on it is the chunk itself. The prefix-gather
+        width ``Lp`` rides the bucket ladder (ISSUE 19): the smallest
+        rung covering the wave's longest attached prefix, garbage
+        beyond each row's prefix hidden by ``prefix_mask`` — the
+        program count stays bounded (one per (window, rung) pair,
+        where chunked attach used to pin ``Lp = t_max`` for the same
+        stability) and a short attach stops gathering the horizon.
+        Reconstruction passes the width its grown prefixes need.
         Rows whose head is fully cached contribute zero suffix tokens
         — a wave that is ALL attach skips the device prefill entirely
         (the block lookup IS the admission). Pure dispatch — no
@@ -2806,9 +2987,7 @@ class ContinuousBatcher:
                 window = (self.Tb if max_m == 0 else
                           max(self.bt,
                               -(-max(suffixes) // self.bt) * self.bt))
-        Lp = -(-max_m // self.bt) * self.bt
-        if self._chunk is not None and max_m:
-            Lp = self.t_max
+        Lp = 0 if max_m == 0 else self._bucket_width(max_m) * self.bt
         final = [(b, known) for b, known, _m, upto in entries
                  if upto >= len(known) - 1]
         if max(suffixes) > 0:

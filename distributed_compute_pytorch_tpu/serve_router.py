@@ -260,7 +260,10 @@ class ServeRouter:
                                     if max_failover_rounds is not None else n)
         # affinity knobs: a match shorter than one block can't skip any
         # prefill; a warm replica more than ~one full row of ticks ahead
-        # of the least-loaded loses the request (module docstring)
+        # of the least-loaded loses the request (module docstring).
+        # t_max stays the right ceiling even though load is accumulated
+        # in width-weighted tick equivalents (ISSUE 19) — those only
+        # ever price a tick at or below its full-width cost
         self.affinity_min_tokens = (affinity_min_tokens
                                     if affinity_min_tokens is not None
                                     else self.replicas[0].bt)
@@ -461,7 +464,14 @@ class ServeRouter:
             # pays ceil(suffix/chunk) admission waves, not one wave per
             # token — raw tokens would systematically overprice
             # long-prompt placements there (unchunked returns suffix
-            # unchanged)
+            # unchanged). Both estimates come back in FULL-WIDTH tick
+            # equivalents: each replica weights its tick count by its
+            # CURRENT width-bucket rung over the full horizon
+            # (ContinuousBatcher._width_fraction, ISSUE 19), so a
+            # replica serving short sessions — whose per-tick KV gather
+            # is a fraction of t_max — undercuts one already stretched
+            # wide by a long session, and the mixed fleet stops pricing
+            # every tick as if it gathered the horizon
             load[target] += rep.prefill_cost(suffix) \
                 + rep.load_estimate(remaining)
             out.setdefault(target, []).append(j)

@@ -190,14 +190,18 @@ def test_moe_refuses_chunking():
 def test_prefill_cost_prices_chunks():
     """The router pricing seam: unchunked cost is the raw suffix,
     chunked cost is ceil(suffix/chunk) admission waves of one segment
-    each — NOT one tick per prompt token."""
+    each — NOT one tick per prompt token. decode_width_buckets=1 pins
+    the full-horizon bucket so the segment units are unweighted (the
+    width-priced form is pinned in tests/test_serve_width.py)."""
     model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
     params, _ = model.init(jax.random.key(0))
-    flat = ContinuousBatcher(model, params, **_COMMON)
+    flat = ContinuousBatcher(model, params, **_COMMON,
+                             decode_width_buckets=1)
     assert flat.prefill_cost(0) == 0 and flat.prefill_cost(-3) == 0
     assert flat.prefill_cost(100) == 100
     cb = ContinuousBatcher(model, params, **_COMMON,
-                           prefill_chunk_tokens=8)
+                           prefill_chunk_tokens=8,
+                           decode_width_buckets=1)
     chunk, S = cb._chunk, cb.S
     assert cb.prefill_cost(1) == S
     assert cb.prefill_cost(chunk) == S

@@ -395,13 +395,17 @@ def test_router_load_estimate_prices_verify_windows():
     """The placement cost the router sums per replica comes from
     ``load_estimate``: a live-spec replica prices ``max_new`` in verify
     windows (k+1 ticks each), a plain replica in segment-rounded
-    ticks — both monotone in max_new."""
+    ticks — both monotone in max_new. decode_width_buckets=1 pins the
+    full-horizon bucket so the tick units are unweighted (the
+    width-priced form is pinned in tests/test_serve_width.py)."""
     model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
     params, _ = model.init(jax.random.key(0))
     plain = ContinuousBatcher(model, params, slots=1, t_max=64,
-                              prompt_buf=8, segment=4)
+                              prompt_buf=8, segment=4,
+                              decode_width_buckets=1)
     spec = ContinuousBatcher(model, params, slots=1, t_max=64,
-                             prompt_buf=8, segment=4, speculate=3)
+                             prompt_buf=8, segment=4, speculate=3,
+                             decode_width_buckets=1)
     assert plain.load_estimate(8) == 8
     assert spec.load_estimate(8) == 8 * 4     # cold: rate 0, windows of 4
     assert spec.load_estimate(16) > spec.load_estimate(4)

@@ -389,15 +389,20 @@ def test_equal_batchers_share_compiled_programs():
 def test_spec_load_estimate_accounts_for_verify_width():
     """The router's cost probe: a live-spec batcher prices max_new in
     verify windows (cold rate=0 -> max_new verifies of k+1 ticks);
-    spec-off and auto-disabled batchers price segment-rounded ticks."""
+    spec-off and auto-disabled batchers price segment-rounded ticks.
+    decode_width_buckets=1 pins the full-horizon bucket so the tick
+    units are unweighted (the width-priced form is pinned in
+    tests/test_serve_width.py)."""
     model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
     params, _ = model.init(jax.random.key(0))
     plain = ContinuousBatcher(model, params, slots=1, t_max=64,
-                              prompt_buf=8, segment=4)
+                              prompt_buf=8, segment=4,
+                              decode_width_buckets=1)
     assert plain.load_estimate(6) == 8            # ceil(6/4)*4
     spec = ContinuousBatcher(model, params, slots=1, t_max=64,
                              prompt_buf=8, segment=4,
-                             speculate=SpecConfig(k=3))
+                             speculate=SpecConfig(k=3),
+                             decode_width_buckets=1)
     assert spec.load_estimate(6) == 6 * 4         # rate 0: 6 verifies of 4
     spec.spec["acceptance_rate"] = 1.0
     assert spec.load_estimate(6) == 2 * 4         # ceil(6/4) verifies
