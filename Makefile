@@ -26,8 +26,8 @@ SHELL := /bin/bash
 
 .PHONY: tier1 test bench bench-smoke serve-chaos-smoke serve-prefix-smoke \
 	serve-tier-smoke serve-spec-smoke serve-kvq-smoke serve-load-smoke \
-	serve-router-smoke serve-disagg-smoke serve-journal-smoke \
-	serve-width-smoke bench-diff
+	serve-router-smoke serve-elastic-smoke serve-disagg-smoke \
+	serve-journal-smoke serve-width-smoke bench-diff
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
@@ -99,6 +99,16 @@ bench:
 #   goodput scales > 1.5x, goodput stays > 0 through the kill with
 #   every stream token-identical to the unloaded single-replica
 #   reference, sessions migrate, and no survivor leaks a slot/block
+# - serve-elastic: the elastic-fleet drill — an offered-load ramp hits
+#   a 1-replica fleet under the ElasticFleetController (max 3) with the
+#   same injected 80 ms harvest latency, and a same-value weight push
+#   lands mid-ramp through the rolling upgrade walk; fails unless the
+#   controller scales up at its first control step with elastic goodput
+#   > 1.3x the fixed single replica on the identical load, the push
+#   drops zero requests with tokens identical to the unloaded
+#   reference, the whole fleet lands on the new weights version,
+#   nothing leaks a slot/block/host block on any member, and the
+#   scale/upgrade events land in the flight recorder
 # - serve-disagg: the chunked + disaggregated prefill drill — a mixed
 #   Poisson stream of short requests and bunched ~1.8k-token prompts
 #   served with chunking off/on against a no-long-prompt baseline, then a
@@ -137,6 +147,7 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-kvq-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-load-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
+	JAX_PLATFORMS=cpu python bench.py --serve-elastic-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-journal-smoke
 	JAX_PLATFORMS=cpu python bench.py --serve-width-smoke
@@ -175,6 +186,9 @@ serve-load-smoke:
 
 serve-router-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-router-smoke
+
+serve-elastic-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-elastic-smoke
 
 serve-disagg-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-disagg-smoke

@@ -2467,6 +2467,141 @@ def serve_router_smoke():
     return 0
 
 
+def serve_elastic_smoke():
+    """Elastic-fleet drill (`make serve-elastic-smoke`, wired into
+    `make bench-smoke`): an offered-load ramp hits a 1-replica fleet
+    under serve_fleet.ElasticFleetController (max 3), with the same
+    injected 80 ms per-harvest `slow` chaos the router smoke uses as
+    stand-in device latency. The controller must scale up at its FIRST
+    control step (goodput tracks the ramp within one scale period —
+    asserted both ways: the decision fires immediately, and elastic
+    goodput beats the fixed 1-replica fleet on the identical load),
+    and a same-value weight push lands mid-ramp via the rolling
+    upgrade walk with ZERO failed requests and exact token parity
+    against the unloaded reference. Every member — original, added,
+    retired — must end slot/block/host-leak-free, and the scale/
+    upgrade events must be visible in the flight recorder."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import dataclasses
+
+    import jax
+    from distributed_compute_pytorch_tpu.models.gpt2 import (
+        GPT2, GPT2Config)
+    from distributed_compute_pytorch_tpu.obs import flight, loadgen
+    from distributed_compute_pytorch_tpu.serve import ContinuousBatcher
+    from distributed_compute_pytorch_tpu.serve_fleet import (
+        ElasticFleetController, ScalePolicy)
+    from distributed_compute_pytorch_tpu.serve_lifecycle import ChaosInjector
+    from distributed_compute_pytorch_tpu.serve_router import ServeRouter
+
+    model = GPT2(dataclasses.replace(GPT2Config.tiny(), max_seq_len=128))
+    params, _ = model.init(jax.random.key(0))
+    kw = dict(slots=2, t_max=64, prompt_buf=12, segment=3,
+              prefix_cache=True, max_recoveries=0)
+
+    def build(p, wv, slot):
+        return ContinuousBatcher(model, p, weights_version=wv, **kw)
+
+    spec = loadgen.LoadSpec(n_requests=24, rate_rps=60.0, seed=3,
+                            prompt_len=(2, 10), max_new=(4, 12))
+    load = loadgen.offered_load(spec)
+
+    def clone(rs, zero_arrival=False):
+        return [dataclasses.replace(
+            r, arrival_s=0.0 if zero_arrival else r.arrival_s)
+            for r in rs]
+
+    SLOW_S = 0.08
+
+    def slow_chaos():
+        # simulated device latency for every replica slot the fleet
+        # could ever grow into (route ignores absent indices)
+        return {i: ChaosInjector(fault_at_segment=0, fault_mode="slow",
+                                 slow_s=SLOW_S, fault_count=1_000_000)
+                for i in range(8)}
+
+    # unloaded, chaos-free parity reference (also the program warmup —
+    # replicas added later share the compiled-program cache)
+    ref_engine = build(params, 0, 0)
+    base = ref_engine.serve_detailed(clone(load, zero_arrival=True))
+    ref_engine.reset()
+
+    # fixed 1-replica fleet on the ramp: the goodput baseline
+    t0 = time.monotonic()
+    fixed_res = ServeRouter([ref_engine]).route(clone(load),
+                                                chaos=slow_chaos())
+    fixed_wall = time.monotonic() - t0
+    fixed_good = (sum(len(r.tokens) for r in fixed_res if r.ok)
+                  / fixed_wall)
+
+    # the elastic run: same ramp, controller live, weight push after
+    # the first window (same param VALUES, new version stamp — the
+    # push must be invisible in tokens)
+    rec = flight.FlightRecorder(capacity=512)
+    prev = flight.configure_flight(rec)
+    try:
+        router = ServeRouter([build(params, 0, 0)])
+        ctl = ElasticFleetController(
+            router, build, params=params,
+            policy=ScalePolicy(min_replicas=1, max_replicas=3,
+                               up_after=1, down_after=99))
+        steps = []
+        orig_step = ctl.control_step
+
+        def logged_step(queued=0):
+            d = orig_step(queued)
+            steps.append((queued, d, ctl.fleet["current_replicas"]))
+            return d
+
+        ctl.control_step = logged_step
+        t0 = time.monotonic()
+        res = ctl.serve_stream(clone(load), window=6,
+                               chaos=slow_chaos(),
+                               upgrade_to=(params, 1))
+        wall = time.monotonic() - t0
+        kinds = {ev["kind"] for ev in rec.events()}
+    finally:
+        flight.configure_flight(prev)
+    goodput = sum(len(r.tokens) for r in res if r.ok) / wall
+
+    leaks = [(r.last_slot_leaks, r.last_block_leaks,
+              r.last_host_block_leaks) for r in router.replicas]
+    ratio = goodput / fixed_good if fixed_good > 0 else 0.0
+    active_wv = [router.replicas[i].weights_version
+                 for i in router.active_replicas()]
+    checks = {
+        "scaled_up_within_one_period":
+            bool(steps) and steps[0][1] == "up",
+        "goodput_tracks_ramp": ratio > 1.3,
+        "zero_failed_through_push": all(r.ok for r in res),
+        "token_parity_through_push":
+            [r.tokens for r in res] == [r.tokens for r in base],
+        "fleet_on_new_version":
+            ctl.fleet["upgrades"] == 1 and active_wv
+            and all(v == 1 for v in active_wv),
+        "zero_leaks": leaks == [(0, 0, 0)] * len(router.replicas),
+        "scale_events_in_flight_recorder":
+            "fleet_scale_up" in kinds and "fleet_upgrade_step" in kinds,
+    }
+    _print_record({
+        "metric": "serve_elastic_smoke",
+        "requests": len(load), "offered_rate_rps": spec.rate_rps,
+        "injected_harvest_latency_s": SLOW_S,
+        "goodput_tok_s": {"fixed_one_replica": round(fixed_good, 2),
+                          "elastic": round(goodput, 2)},
+        "wall_s": {"fixed_one_replica": round(fixed_wall, 3),
+                   "elastic": round(wall, 3)},
+        "scaling_ratio": round(ratio, 3),
+        "control_steps": [{"queued": q, "decision": d, "replicas": n}
+                          for q, d, n in steps],
+        "fleet": dict(ctl.fleet),
+        "checks": checks})
+    bad = [k for k, ok in checks.items() if not ok]
+    if bad:
+        raise SystemExit(f"serve elastic smoke failed: {bad}")
+    return 0
+
+
 def serve_disagg_smoke():
     """Long-prompt storm + disaggregated-fleet drill for chunked
     prefill (`make serve-disagg-smoke`, wired into `make bench-smoke`).
@@ -3120,6 +3255,8 @@ def main():
         return serve_load_smoke()
     if "--serve-router-smoke" in sys.argv:
         return serve_router_smoke()
+    if "--serve-elastic-smoke" in sys.argv:
+        return serve_elastic_smoke()
     if "--serve-disagg-smoke" in sys.argv:
         return serve_disagg_smoke()
     if "--serve-journal-smoke" in sys.argv:

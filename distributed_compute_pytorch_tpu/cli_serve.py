@@ -217,6 +217,39 @@ def main(argv=None) -> int:
                    help="with --replicas > 1, which replica the "
                         "injected --fault_at_segment chaos targets "
                         "(drills failover-by-migration)")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                   help="elastic fleet (ISSUE 20): wrap the replica "
+                        "set in serve_fleet.ElasticFleetController and "
+                        "let load scale it between MIN and MAX "
+                        "replicas. Requests are served in windows with "
+                        "a control step between: queue depth + SLO "
+                        "burn feed a hysteresis/cooldown decider, "
+                        "scale-ups come up warm off the shared "
+                        "compiled-program cache, scale-downs drain by "
+                        "migration (token-identical on survivors), "
+                        "and breaker-DEAD replicas are replaced. "
+                        "--replicas sets the starting size")
+    p.add_argument("--elastic_window", type=int, default=8,
+                   help="with --autoscale/--upgrade_to: requests per "
+                        "serving window (the control-loop period)")
+    p.add_argument("--upgrade_to", default=None, metavar="CKPT",
+                   help="rolling weight upgrade (ISSUE 20): after the "
+                        "first serving window, walk the fleet one "
+                        "replica at a time — drain by migration, "
+                        "reload weights from CKPT in place (compiled "
+                        "programs survive), re-admit — with zero "
+                        "dropped requests. Bumps the fleet's weights "
+                        "version; the version stamp keeps old-version "
+                        "KV prefixes off the new weights")
+    p.add_argument("--weights_version", type=int, default=0,
+                   help="version stamp for the served weights (ISSUE "
+                        "20): threads through radix entries, tier "
+                        "sidecars, handoff payloads and the journal "
+                        "config frame so cross-version KV reuse "
+                        "declines to token replay. A journaled run "
+                        "recovered under a different version warns "
+                        "and replays incomplete sessions from tokens "
+                        "(completed ids still dedup)")
     p.add_argument("--prefill_chunk_tokens", type=int, default=None,
                    help="chunked prefill: cap each admission wave's "
                         "prefill at N prompt tokens (rounded up to a "
@@ -441,6 +474,30 @@ def main(argv=None) -> int:
         raise SystemExit("--top_k/--top_p require --temperature > 0")
     if args.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
+    if args.weights_version < 0:
+        raise SystemExit("--weights_version must be >= 0")
+    if args.elastic_window < 1:
+        raise SystemExit("--elastic_window must be >= 1")
+    autoscale = None
+    if args.autoscale is not None:
+        try:
+            lo, _, hi = args.autoscale.partition(":")
+            autoscale = (int(lo), int(hi))
+        except ValueError:
+            raise SystemExit(f"--autoscale wants MIN:MAX, got "
+                             f"{args.autoscale!r}")
+        if not 1 <= autoscale[0] <= autoscale[1]:
+            raise SystemExit(f"--autoscale needs 1 <= MIN <= MAX, got "
+                             f"{args.autoscale!r}")
+    elastic = args.autoscale is not None or args.upgrade_to is not None
+    if elastic and args.profile_segments is not None:
+        raise SystemExit("--profile_segments profiles one fixed "
+                         "batcher; not supported with --autoscale/"
+                         "--upgrade_to")
+    if elastic and args.mesh is not None:
+        raise SystemExit("--autoscale/--upgrade_to build replicas "
+                         "dynamically: not supported with --mesh (one "
+                         "process drives one device set)")
     if args.replicas > 1 and args.mesh is not None:
         raise SystemExit("--replicas > 1 with --mesh is not supported "
                          "from this CLI: each replica would need its own "
@@ -511,6 +568,19 @@ def main(argv=None) -> int:
                 f"--journal_dir was written with kv_dtype="
                 f"{jc.get('kv_dtype', 'bf16')}, refusing to recover "
                 f"with --kv_dtype {args.kv_dtype}")
+        # a weights-version mismatch is SAFE to recover across (unlike
+        # kv_dtype): completed ids still dedup, and incomplete sessions
+        # replay from their journaled tokens — token replay never
+        # touches old-version KV. One line so the operator knows the
+        # push happened between crash and restart.
+        jwv = recovery.weights_version
+        if (recovery.frames and jwv is not None
+                and jwv != args.weights_version):
+            print(f"warning: journal was written at weights_version="
+                  f"{jwv}, recovering under {args.weights_version}: "
+                  f"incomplete sessions replay from tokens (no "
+                  f"cross-version KV reuse)", file=sys.stderr,
+                  flush=True)
     # SIGTERM/SIGINT -> graceful drain, armed BEFORE the heavy imports /
     # checkpoint load / compiles so a preemption at ANY point of startup
     # drains instead of dying mid-load (the trainer's PreemptionGuard,
@@ -625,9 +695,10 @@ def main(argv=None) -> int:
                                              fsync=args.journal_fsync)
         # stamp this process's config so the NEXT restart can refuse a
         # mismatched --kv_dtype before touching any session
-        journal.config({"kv_dtype": args.kv_dtype})
+        journal.config({"kv_dtype": args.kv_dtype,
+                        "weights_version": args.weights_version})
 
-    def build_batcher(replica=None):
+    def build_batcher(replica=None, rep_params=None, weights_version=None):
         hb_cb = None
         if args.heartbeat:
             hb_cb = (on_heartbeat if replica is None else
@@ -637,7 +708,9 @@ def main(argv=None) -> int:
             # one failure domain per replica: separate spill directories
             disk_dir = os.path.join(disk_dir, f"replica-{replica}")
         return ContinuousBatcher(
-            model, params, slots=args.slots, t_max=t_max,
+            model,
+            params if rep_params is None else rep_params,
+            slots=args.slots, t_max=t_max,
             prompt_buf=prompt_buf, segment=args.segment,
             eos_id=args.eos_id, mesh=mesh,
             admit_policy=args.admit_policy,
@@ -654,10 +727,13 @@ def main(argv=None) -> int:
             prefill_chunk_tokens=args.prefill_chunk_tokens,
             journal=journal,
             kv_dtype=args.kv_dtype,
-            decode_width_buckets=args.decode_width_buckets)
+            decode_width_buckets=args.decode_width_buckets,
+            weights_version=(args.weights_version
+                             if weights_version is None
+                             else weights_version))
 
     router = None
-    if args.replicas > 1:
+    if args.replicas > 1 or elastic:
         from distributed_compute_pytorch_tpu.serve_router import ServeRouter
         router = ServeRouter([build_batcher(i)
                               for i in range(args.replicas)],
@@ -665,6 +741,29 @@ def main(argv=None) -> int:
         cb = router.replicas[0]        # profile/SIGUSR1 target
     else:
         cb = build_batcher()
+
+    controller = None
+    upgrade_to = None
+    if elastic:
+        from distributed_compute_pytorch_tpu.serve_fleet import (
+            ElasticFleetController, ScalePolicy)
+        lo, hi = autoscale if autoscale else (args.replicas,
+                                              args.replicas)
+        controller = ElasticFleetController(
+            router,
+            lambda p, wv, slot: build_batcher(slot, rep_params=p,
+                                              weights_version=wv),
+            params=params, weights_version=args.weights_version,
+            policy=ScalePolicy(min_replicas=lo, max_replicas=hi))
+        if args.upgrade_to:
+            # the new weights load through the same checkpoint-restore
+            # path as the serving set; the rolling walk pushes them
+            # after the first window
+            _, new_params, _ = load_model_and_params(
+                args.model, args.model_preset, args.vocab_size,
+                args.max_seq_len, args.upgrade_to, mesh_spec=args.mesh,
+                quantize=args.quantize)
+            upgrade_to = (new_params, args.weights_version + 1)
 
     if args.prewarm_widths:
         # one batcher warms the fleet: replicas share compiled programs
@@ -710,7 +809,15 @@ def main(argv=None) -> int:
                                     deadline_s=r["deadline"],
                                     request_id=r["id"])
                             for i, r in enumerate(reqs)]
-                if router is not None:
+                if controller is not None:
+                    results = controller.serve_stream(
+                        requests, window=args.elastic_window,
+                        drain=guard,
+                        drain_deadline_s=args.drain_deadline,
+                        chaos=({args.fault_replica: chaos}
+                               if chaos is not None else None),
+                        recovery=recovery, upgrade_to=upgrade_to)
+                elif router is not None:
                     results = router.route(
                         requests, drain=guard,
                         drain_deadline_s=args.drain_deadline,
@@ -727,7 +834,9 @@ def main(argv=None) -> int:
     finally:
         # telemetry flushes on EVERY exit path (drain, fault, Ctrl-C x2)
         if metrics_f is not None:
-            snap = (router.stats_snapshot() if router is not None
+            snap = (controller.stats_snapshot()
+                    if controller is not None
+                    else router.stats_snapshot() if router is not None
                     else cb.stats_snapshot())
             metrics_f.write(json.dumps({"kind": "serve_final",
                                         "ts": time.time(),

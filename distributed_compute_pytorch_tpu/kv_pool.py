@@ -179,6 +179,13 @@ class _Entry:
     host_blocks: list = field(default_factory=list)
     disk_key: "str | None" = None
     tokens: tuple = ()    # the head sequence (demotion/debug bookkeeping)
+    # weights-version stamp (ISSUE 20): the version of the model
+    # weights that computed these K/V bytes. Lookups refuse entries
+    # from any other version — a rolling weight upgrade clears the
+    # cache wholesale, and this stamp is the per-entry proof that a
+    # stale prefix can never attach to new weights even if one slipped
+    # through (adoption, import, a future partial-invalidation path)
+    weights_version: int = 0
 
 
 class RadixCache:
@@ -205,6 +212,10 @@ class RadixCache:
         # entry whose spill-tier copy must be dropped — a fresh insert
         # revived it with resident blocks, or eviction discarded it
         self.on_tier_drop = None
+        # current weights-version stamp: inserts stamp their entries
+        # with it, lookups refuse entries stamped otherwise (serve's
+        # reload_weights bumps it after clearing the tree)
+        self.weights_version = 0
 
     # ---- stats / accounting ------------------------------------------------
 
@@ -323,7 +334,8 @@ class RadixCache:
         stack, demoted = [node], None
         while stack:
             n = stack.pop()
-            if n.entry is not None:
+            if (n.entry is not None
+                    and n.entry.weights_version == self.weights_version):
                 if n.entry.tier == TIER_DEVICE:
                     return n.entry
                 if demoted is None:
@@ -386,6 +398,7 @@ class RadixCache:
                 node.entry.tier = TIER_DEVICE
                 node.entry.host_blocks = []
                 node.entry.disk_key = None
+                node.entry.weights_version = self.weights_version
                 node.entry.last_used = self._tick()
                 for b in blocks:
                     self.pool.acquire(b)
@@ -393,7 +406,8 @@ class RadixCache:
             node.entry.last_used = self._tick()
             return False
         node.entry = _Entry(blocks=list(blocks), n_tokens=len(tokens),
-                            last_used=self._tick(), tokens=tokens)
+                            last_used=self._tick(), tokens=tokens,
+                            weights_version=self.weights_version)
         for b in blocks:
             self.pool.acquire(b)
         self.entries.append(node.entry)
@@ -419,7 +433,8 @@ class RadixCache:
             return None
         node.entry = _Entry(blocks=[], n_tokens=len(tokens),
                             last_used=self._tick(), tier=TIER_HOST,
-                            tokens=tokens)
+                            tokens=tokens,
+                            weights_version=self.weights_version)
         self.entries.append(node.entry)
         return node.entry
 

@@ -299,13 +299,17 @@ class DiskTier:
             finally:
                 self._q.task_done()
 
-    def put(self, content, tokens=()) -> str:
+    def put(self, content, tokens=(), weights_version: int = 0) -> str:
         key = f"part-{self._seq:05d}"
         self._seq += 1
         kv = content["kv"] if isinstance(content, dict) else content
+        # the sidecar stamps which model weights computed these bytes
+        # (ISSUE 20): a restarted — or upgraded — engine only adopts
+        # shards whose stamp matches its own weights_version
         rec = {"key": key, "crc": _crc(kv),
                "shape": list(kv.shape), "dtype": str(kv.dtype),
-               "tokens": [int(t) for t in tokens]}
+               "tokens": [int(t) for t in tokens],
+               "weights_version": int(weights_version)}
         if isinstance(content, dict) and "scale" in content:
             sc = content["scale"]
             rec["scale_crc"] = _crc(sc)
@@ -410,6 +414,12 @@ class KVTierManager:
         self.host = host
         self.disk = disk
         self.stats = dict(TIER_STATS) if stats is None else stats
+        # weights-version stamp (ISSUE 20): disk spills write it into
+        # their sidecars, adoption refuses shards stamped otherwise.
+        # The serve engine sets both (and rebinds fleet_stats to its
+        # serve.fleet.* MetricDict so declines surface in snapshots).
+        self.weights_version = 0
+        self.fleet_stats = {"version_declined": 0}
         self._demoted: list = []     # entries in HOST or DISK tier
         # an entry mid-promotion: its device-block allocation may
         # demote/spill colder entries, but never the one being
@@ -457,7 +467,9 @@ class KVTierManager:
         if self.disk is not None:
             content = self.host.read(victim.host_blocks)
             victim.disk_key = self.disk.put(
-                content, tokens=getattr(victim, "tokens", ()) or ())
+                content, tokens=getattr(victim, "tokens", ()) or (),
+                weights_version=getattr(victim, "weights_version",
+                                        self.weights_version))
             self.host.release(victim.host_blocks)
             victim.host_blocks = []
             victim.tier = TIER_DISK
@@ -522,8 +534,12 @@ class KVTierManager:
         dtype is skipped (adopting it would feed the compiled promote
         a mis-shaped array), a scale-carrying shard never adopts into
         a bf16 pool and vice versa, and the scale geometry must match
-        too. As is any prefix already resident. Returns the number of
-        entries adopted."""
+        too. As is any prefix already resident. Shards stamped with a
+        different ``weights_version`` decline with
+        ``fleet_stats["version_declined"]`` (ISSUE 20) — geometry can
+        match across a weight push; the stamp is what proves the bytes
+        belong to THESE weights. Returns the number of entries
+        adopted."""
         if self.disk is None:
             return 0
         adopted = 0
@@ -532,6 +548,14 @@ class KVTierManager:
             toks = rec.get("tokens") or []
             if not toks:
                 continue             # pre-journal shard: no identity
+            if (int(rec.get("weights_version", 0))
+                    != int(self.weights_version)):
+                # KV computed under other weights (a pre-upgrade
+                # process, or a journal recovered cross-version):
+                # DECLINE — the incomplete sessions it would have
+                # warmed replay from tokens instead (ISSUE 20)
+                self.fleet_stats["version_declined"] += 1
+                continue
             exp = expect(len(toks))
             shape, dtype = exp[0], exp[1]
             want_scale = exp[2:] if len(exp) > 2 else None

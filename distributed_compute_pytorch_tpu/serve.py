@@ -420,7 +420,8 @@ class ContinuousBatcher:
                  journal_dir: str | None = None,
                  journal_fsync: str = "every_harvest",
                  kv_dtype: str = "bf16",
-                 decode_width_buckets: int | None = None):
+                 decode_width_buckets: int | None = None,
+                 weights_version: int = 0):
         from distributed_compute_pytorch_tpu.ops.pallas.cache_update import (
             _pallas_ok, _window)
         if prompt_buf > t_max:
@@ -588,6 +589,14 @@ class ContinuousBatcher:
                   if jnp.issubdtype(l.dtype, jnp.floating)]
         self._cdtype = floats[0].dtype if floats else jnp.float32
         self.kv_dtype = kv_dtype
+        # weights-version stamp (ISSUE 20): every KV byte this engine
+        # caches (radix entries, tier sidecars, handoff payloads) is
+        # stamped with the version of the weights that computed it, so
+        # an old-version prefix can never attach to new weights — a
+        # mismatch anywhere DECLINES (serve.fleet.version_declined) and
+        # falls back to token replay, never raises. reload_weights()
+        # bumps it.
+        self.weights_version = int(weights_version)
         dtype = jnp.int8 if kv_dtype == "int8" else self._cdtype
         # block size: a multiple of the in-place Pallas slot write's
         # window so the paged write keeps the one-window-DMA fast path
@@ -687,6 +696,9 @@ class ContinuousBatcher:
         self._tables = np.full((slots, self.nb), BlockPool.TRASH, np.int32)
         self._radix = (RadixCache(self._pool, self.bt)
                        if prefix_cache else None)
+        if self._radix is not None:
+            # every entry inserted from here carries the stamp
+            self._radix.weights_version = self.weights_version
         # hierarchical KV (kv_tier.py): a host-RAM block pool (and an
         # optional CRC-verified disk tier below it) that eviction
         # demotes into and admission promotes from — the radix working
@@ -708,6 +720,9 @@ class ContinuousBatcher:
                                            else None)),
                 DiskTier(disk_cache_dir, async_writes=True)
                 if disk_cache_dir else None)
+            # disk spills stamp their sidecars with this; adoption
+            # declines shards carrying any other stamp (ISSUE 20)
+            self._tier.weights_version = self.weights_version
         # per-row slot of the last written token (host-tracked: admission
         # rewinds a row to its head length - 1; each segment advances
         # every row by S; parked rows sit at 0 writing into trash)
@@ -939,6 +954,17 @@ class ContinuousBatcher:
             "gathered_block_reads": 0, "full_width_block_reads": 0,
             "bytes_saved_vs_full": 0, "bucket_growths": 0,
             "prewarmed_programs": 0})
+        # elastic-fleet attribution, engine side (ISSUE 20): the
+        # running weights' version stamp, hot reloads paid, and
+        # cross-version KV declines (handoff imports + disk-shard
+        # adoptions refused for a stamp mismatch — each one a replay
+        # fallback, never an error). The fleet controller aggregates
+        # these per-replica dicts under its own scale/upgrade counters.
+        self.fleet = obs_metrics.MetricDict(self.obs, "serve.fleet.", {
+            "weights_version": int(getattr(self, "weights_version", 0)),
+            "weight_reloads": 0, "version_declined": 0})
+        if getattr(self, "_tier", None) is not None:
+            self._tier.fleet_stats = self.fleet
         self.last_host_block_leaks = 0  # host blocks unaccounted at exit
         # per-request SLO distributions (serve_lifecycle.RequestResult
         # field docs define the measurement points); seconds, log
@@ -964,6 +990,7 @@ class ContinuousBatcher:
             "journal": dict(self.journal),
             "kvq": dict(self.kvq),
             "width": dict(self.width),
+            "fleet": dict(self.fleet),
             "slo": {name: h.summary() for name, h in self._slo.items()},
             "ticks": self.ticks,
             "slot_leaks": self.last_slot_leaks,
@@ -1042,7 +1069,8 @@ class ContinuousBatcher:
         self.prefill["handoff_bytes"] += total
         payload = {"tokens": tuple(head[:m]), "n_tokens": m,
                    "kv": kv, "crc": _crc(kv), "bt": self.bt,
-                   "kv_dtype": self.kv_dtype}
+                   "kv_dtype": self.kv_dtype,
+                   "weights_version": self.weights_version}
         if "scale" in content:
             payload["scale"] = content["scale"]
             payload["scale_crc"] = _crc(content["scale"])
@@ -1083,7 +1111,10 @@ class ContinuousBatcher:
         ``"scale_crc"`` verifies, a bf16 pool refuses any payload
         carrying one, and a ``"kv_dtype"`` stamp mismatch declines
         with its own counter (``serve.kvq.handoff_dtype_declined``) —
-        every mismatch declines to replay, never raises."""
+        every mismatch declines to replay, never raises. The
+        ``"weights_version"`` stamp is checked the same way (ISSUE 20):
+        KV computed under other weights declines with
+        ``serve.fleet.version_declined``."""
         if self._radix is None or not payload:
             return False
         if payload.get("kv_dtype", "bf16") != self.kv_dtype:
@@ -1092,6 +1123,14 @@ class ContinuousBatcher:
             # convention and vice versa (cli_serve validates the fleet;
             # this guards cross-process handoffs)
             self.kvq["handoff_dtype_declined"] += 1
+            self.prefill["handoff_declined"] += 1
+            return False
+        if int(payload.get("weights_version", 0)) != self.weights_version:
+            # KV computed under different weights is not this model's
+            # state — mid-rolling-upgrade handoffs between versions
+            # decline to replay (ISSUE 20), exactly like a dtype
+            # mismatch, and the counter makes the decline visible
+            self.fleet["version_declined"] += 1
             self.prefill["handoff_declined"] += 1
             return False
         kv = payload.get("kv")
@@ -1260,6 +1299,41 @@ class ContinuousBatcher:
         self._widths_dispatched.clear()
         self.ticks = 0
         self._zero_stats()
+
+    def reload_weights(self, params, weights_version: int | None = None):
+        """HOT WEIGHT SWAP (ISSUE 20): install ``params`` as this
+        engine's serving weights and stamp every byte cached from here
+        on with ``weights_version`` (defaults to the current version
+        + 1). The caller must be between serve calls — the fleet
+        controller's upgrade walk drains a replica's live sessions to
+        survivors first (they replay token-identically there), reloads,
+        then re-admits it to dispatch.
+
+        Everything KV-derived is dropped — radix cache (all tiers,
+        including this replica's own disk shards: a same-process
+        ``fetch`` has no version gate, so stale shards must not
+        survive the swap), block pool, row state — because KV computed
+        under the old weights is not the new model's state. The
+        COMPILED programs survive: params enter every dispatch as
+        traced arguments (the `_PROGRAM_CACHE` key is config-derived),
+        so a reloaded replica re-enters traffic with zero recompiles —
+        the whole point of upgrading in place instead of respawning."""
+        if weights_version is None:
+            weights_version = self.weights_version + 1
+        old = self.weights_version
+        self.params = params
+        self.weights_version = int(weights_version)
+        self.reset()
+        if self._radix is not None:
+            self._radix.weights_version = self.weights_version
+        if self._tier is not None:
+            self._tier.weights_version = self.weights_version
+        self.fleet["weight_reloads"] += 1
+        self.fleet["weights_version"] = self.weights_version
+        instant("weights_reloaded", old_version=old,
+                new_version=self.weights_version)
+        flight.record("weights_reloaded", old_version=old,
+                      new_version=self.weights_version)
 
     # ---- compiled pieces -------------------------------------------------
 

@@ -190,13 +190,16 @@ class ServeJournal:
     def config(self, obj: dict) -> None:
         """Process-config frame (ISSUE 16): the serving configuration
         whose mismatch across a restart would silently change recovered
-        streams — today the pool ``kv_dtype`` (int8 emitted tokens are
-        not bit-promises a bf16 pool can keep, and vice versa).
-        Written once, right after the journal opens; ``recover()``
-        surfaces the LAST one in ``RecoveryManifest.config`` and
-        ``cli_serve`` refuses a mismatched restart with a one-line
-        error instead of replaying sessions under a different
-        numeric contract."""
+        streams — the pool ``kv_dtype`` (int8 emitted tokens are
+        not bit-promises a bf16 pool can keep, and vice versa) and,
+        since ISSUE 20, the ``weights_version`` stamp. Written once,
+        right after the journal opens; ``recover()`` surfaces the LAST
+        one in ``RecoveryManifest.config``. ``cli_serve`` refuses a
+        mismatched ``kv_dtype`` restart with a one-line error, but a
+        mismatched ``weights_version`` only WARNS and falls back to
+        token replay — replaying tokens under new weights is sound
+        (the stream continues under the new model), it is stamped KV
+        that must not cross versions."""
         self._append({"kind": "config", "config": dict(obj)})
 
     def delta(self, rid: str, tokens) -> None:
@@ -274,6 +277,20 @@ class RecoveryManifest:
     # the last journaled config frame (None = pre-ISSUE 16 journal):
     # restart validation compares it against the requested flags
     config: dict | None = None
+
+    @property
+    def weights_version(self) -> int | None:
+        """The ``weights_version`` the journaling process served under
+        (ISSUE 20), or None for a journal predating the stamp. A
+        restart under a DIFFERENT version still dedups completed ids
+        (emitted streams are history, whatever computed them) but must
+        replay incomplete sessions from tokens instead of adopting any
+        version-stamped KV — ``cli_serve`` warns and proceeds rather
+        than refusing, because token replay is version-safe by
+        construction."""
+        if self.config is None or "weights_version" not in self.config:
+            return None
+        return int(self.config["weights_version"])
 
     @property
     def completed(self) -> dict:

@@ -82,6 +82,20 @@ Every failover dumps the flight ring (``reason="replica_failover"``)
 naming the dead replica and the migrated sessions; all events a
 replica records are tagged with its index via ``flight.replica_tag``
 wrapped around each worker thread.
+
+Elastic membership (ISSUE 20, driven by ``serve_fleet.
+ElasticFleetController``): the replica set is no longer fixed at
+construction. :meth:`ServeRouter.add_replica` appends a warm member
+(scale-up, or the replacement for a breaker-DEAD one);
+:meth:`ServeRouter.retire_replica` removes one — mid-round it drains
+that single replica through a per-replica latch ORed into its drain
+object, and the cut sessions re-enter the next round on survivors
+exactly like a failover, minus the fault. Indices are stable (a
+retired slot goes quiet, never reused), RETIRED is terminal to the
+probe machinery (``probe_replica`` refuses; only the controller's
+``readmit_replica`` — the upgrade walk's re-admission — returns one),
+and a transiently mixed-``weights_version`` fleet is legal: handoffs
+only target same-version replicas, token replay covers the rest.
 """
 
 from __future__ import annotations
@@ -108,6 +122,13 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 DEAD = "dead"
+# membership state (ISSUE 20): a RETIRED replica has been removed from
+# the fleet on purpose — scale-down, replacement of a DEAD member, or
+# the drain step of a rolling weight upgrade. Terminal for probing:
+# record_ok/record_fault/probe_replica all refuse to flip it (the
+# replacement already holds its traffic), only the controller's
+# explicit readmit_replica (the upgrade walk's re-admission) does.
+RETIRED = "retired"
 
 
 class CircuitBreaker:
@@ -144,12 +165,16 @@ class CircuitBreaker:
         return self.state == CLOSED
 
     def record_ok(self) -> None:
+        if self.state == RETIRED:
+            return            # membership is the controller's call
         self.consecutive = 0
         self._k = 0
         self.retry_at = None
         self.state = CLOSED
 
     def record_fault(self, now: float) -> None:
+        if self.state == RETIRED:
+            return            # already out of the fleet
         self.consecutive += 1
         if self.state == HALF_OPEN or self.consecutive >= self.fault_threshold:
             self.trips += 1
@@ -190,6 +215,24 @@ class _Session:
     cached_prefix: int = 0
     queue_wait_s: float | None = None
     ttft_s: float | None = None
+
+
+class _ReplicaDrain:
+    """The drain object each worker hands its replica: the OR of the
+    cluster-wide latch and that replica's retirement flag (ISSUE 20).
+    A retirement mid-round looks, to the one replica, exactly like a
+    SIGTERM drain — admission stops, in-flight rows finish, the queue
+    sheds — but the ROUTER re-places the cut sessions on survivors
+    instead of finalising them, because only this member is leaving."""
+
+    def __init__(self, router: "ServeRouter", i: int, drain):
+        self._router, self._i, self._drain = router, i, drain
+
+    @property
+    def preempted(self) -> bool:
+        return bool(self._router._retiring[self._i]
+                    or (self._drain is not None
+                        and getattr(self._drain, "preempted", False)))
 
 
 class ServeRouter:
@@ -252,6 +295,7 @@ class ServeRouter:
                              f"{prefill_replicas}")
         self.prefill_replicas = prefill_replicas
         self._prefill_set = frozenset(range(prefill_replicas))
+        self.fault_threshold = fault_threshold
         self.probe_budget = probe_budget
         self.probe_base_delay_s = probe_base_delay_s
         self.jitter_seed = jitter_seed
@@ -276,6 +320,11 @@ class ServeRouter:
             probe_base_delay_s=probe_base_delay_s,
             jitter_seed=jitter_seed + i) for i in range(n)]
         self._busy = [False] * n      # a worker (possibly zombie) holds it
+        # per-replica retirement latch (ISSUE 20): flipping it mid-round
+        # drains that one replica (its serve_detailed sees `preempted`)
+        # without touching the cluster drain; the round classifier
+        # migrates its cut sessions to survivors
+        self._retiring = [False] * n
         self._last_beat: list[float | None] = [None] * n
         self._last_snap: list[dict | None] = [None] * n
         self._threads: list[threading.Thread] = []
@@ -291,7 +340,12 @@ class ServeRouter:
                       # completions returned without device work, and
                       # the emitted tokens re-entered as replay prefix
                       "journal_recovered": 0, "journal_deduped": 0,
-                      "journal_replay_tokens": 0}
+                      "journal_replay_tokens": 0,
+                      # elastic membership (ISSUE 20): replicas retired
+                      # from / added to the fleet, and sessions a
+                      # retirement drain migrated to survivors (these
+                      # also count under "migrations")
+                      "retired": 0, "added": 0, "retire_migrations": 0}
         for i, rep in enumerate(self.replicas):
             self._wire_heartbeat(i, rep)
 
@@ -318,6 +372,89 @@ class ServeRouter:
     def healthy_replicas(self) -> list[int]:
         return [i for i, b in enumerate(self._breakers)
                 if b.healthy and not self._busy[i]]
+
+    def active_replicas(self) -> list[int]:
+        """Fleet members in ANY state but RETIRED — the set the elastic
+        controller sizes, walks, and replaces over. (Healthy is a
+        dispatch property; active is a membership property.)"""
+        return [i for i, b in enumerate(self._breakers)
+                if b.state != RETIRED]
+
+    # ---- membership (ISSUE 20) ---------------------------------------------
+
+    def retire_replica(self, i: int) -> None:
+        """Remove replica ``i`` from the fleet: no new placements, no
+        probes, and if a round is in flight its worker drains NOW (the
+        per-replica latch reads as ``preempted`` inside that replica's
+        ``serve_detailed`` only) — in-flight rows finish, queued work
+        sheds, and the round classifier re-enters every cut session on
+        the survivors, token-identically (``_sub_request``'s
+        continuation path: a retirement is a PLANNED failover).
+        Retirement is terminal for the probe machinery — an operator
+        ``probe_replica`` cannot revive a replaced member (the race the
+        unit tests pin); only :meth:`readmit_replica`, the explicit
+        re-admission step of the controller's upgrade walk, returns a
+        retired replica to dispatch. Idempotent. Indices are stable:
+        the slot is never reused, its lists just go quiet."""
+        b = self._breakers[i]
+        if b.state == RETIRED:
+            return
+        self._retiring[i] = True
+        b.state = RETIRED
+        b.retry_at = None
+        self.stats["retired"] += 1
+        instant("replica_retired", replica=i)
+        flight.record("replica_retired", replica=i,
+                      busy=self._busy[i])
+
+    def readmit_replica(self, i: int) -> None:
+        """Return a RETIRED replica to dispatch (the upgrade walk's
+        re-admission: sessions were drained off, weights reloaded, and
+        the replica is warm again). No-op unless retired."""
+        b = self._breakers[i]
+        if b.state != RETIRED:
+            return
+        self._retiring[i] = False
+        b.state = CLOSED
+        b.consecutive = 0
+        b._k = 0
+        b.retry_at = None
+        instant("replica_readmitted", replica=i)
+        flight.record("replica_readmitted", replica=i)
+
+    def add_replica(self, rep, *, prefill: bool = False) -> int:
+        """Grow the fleet by one warm replica (scale-up, or the
+        replacement for a retired/DEAD member) and return its index.
+        The new member enters with a CLOSED breaker and receives
+        traffic from the next placement on. Same-``kv_dtype`` is
+        enforced exactly as at construction. Append order matters: the
+        breaker lands LAST because ``healthy_replicas``/``_partition``
+        enumerate ``self._breakers`` — every parallel per-index list
+        must already hold index ``i`` when it becomes visible."""
+        if getattr(rep, "kv_dtype", "bf16") != self.kv_dtype:
+            raise ValueError(
+                f"all replicas must share one kv_dtype, got "
+                f"{getattr(rep, 'kv_dtype', 'bf16')!r} vs "
+                f"{self.kv_dtype!r}")
+        i = len(self.replicas)
+        self.replicas.append(rep)
+        self._busy.append(False)
+        self._retiring.append(False)
+        self._last_beat.append(None)
+        self._last_snap.append(None)
+        self.routed_per_replica.append(0)
+        self._wire_heartbeat(i, rep)
+        if prefill:
+            self._prefill_set = frozenset(self._prefill_set | {i})
+        self._breakers.append(CircuitBreaker(
+            fault_threshold=self.fault_threshold,
+            probe_budget=self.probe_budget,
+            probe_base_delay_s=self.probe_base_delay_s,
+            jitter_seed=self.jitter_seed + i))
+        self.stats["added"] += 1
+        instant("replica_added", replica=i, prefill=prefill)
+        flight.record("replica_added", replica=i, prefill=prefill)
+        return i
 
     def stats_snapshot(self) -> dict:
         """Router counters + per-replica breaker/health/engine state —
@@ -383,8 +520,12 @@ class ServeRouter:
         attempts through ``elastic.retry_with_backoff`` (deterministic
         schedule, per-replica jitter seed). Success re-closes the
         breaker — including a DEAD one, which auto-probing never
-        revives; failure records a fault and returns False."""
-        if self._busy[i]:
+        revives; failure records a fault and returns False. A RETIRED
+        replica always returns False without a canary: it was removed
+        on purpose (likely already replaced), so reviving it would
+        double capacity behind the controller's back — membership
+        changes go through retire/add/readmit, not probes."""
+        if self._busy[i] or self._breakers[i].state == RETIRED:
             return False
         self.stats["probes"] += 1
         try:
@@ -665,6 +806,11 @@ class ServeRouter:
         errs: dict[int, BaseException] = {}
         threads: dict[int, threading.Thread] = {}
         hops: dict[int, set[int]] = {}
+        # retirement state CAPTURED by each worker as it exits: an
+        # upgrade thread gating on `not _busy[i]` may readmit (clear
+        # the latch) before this round's classification runs, and the
+        # replica's shed sessions must still migrate, not finalise
+        retired_at_exit: dict[int, bool] = {}
         round_start = now
         for i, idxs in placement.items():
             subs = []
@@ -685,12 +831,13 @@ class ServeRouter:
                 with flight.replica_tag(_i):
                     try:
                         outs[_i] = self.replicas[_i].serve_detailed(
-                            _subs, drain=drain,
+                            _subs, drain=_ReplicaDrain(self, _i, drain),
                             drain_deadline_s=drain_deadline_s,
                             chaos=chaos.get(_i))
                     except BaseException as e:  # noqa: BLE001
                         errs[_i] = e
                     finally:
+                        retired_at_exit[_i] = self._retiring[_i]
                         self._busy[_i] = False
 
             self._busy[i] = True
@@ -738,6 +885,16 @@ class ServeRouter:
                 continue
             res = outs.get(i, [])
             hop = hops.get(i, set())
+            # retirement drain (ISSUE 20): the per-replica latch cut
+            # this replica's round short. Its SHED/CANCELLED results
+            # are not failures — they are the planned half of a
+            # migration, so they re-enter the next round on survivors
+            # with their partial streams banked (unless the CLUSTER is
+            # draining too, in which case finalising wins: nobody will
+            # serve them anyway)
+            retiring = (retired_at_exit.get(i, self._retiring[i])
+                        and not (drain is not None
+                                 and getattr(drain, "preempted", False)))
             faulted: list[tuple[int, RequestResult]] = []
             for j, r in zip(idxs, res):
                 if (r.status == FAILED and r.error
@@ -749,6 +906,18 @@ class ServeRouter:
                     sess.queue_wait_s = slo_base + r.queue_wait_s
                 if sess.ttft_s is None and r.ttft_s is not None:
                     sess.ttft_s = slo_base + r.ttft_s
+                if (retiring and r.status in (SHED, CANCELLED)
+                        and not (sess.deadline_at is not None
+                                 and now >= sess.deadline_at)):
+                    sess.tokens.extend(r.tokens)
+                    sess.ticks += r.ticks
+                    sess.recoveries += r.recoveries
+                    sess.cached_prefix += r.cached_prefix_tokens
+                    sess.migrated += 1
+                    self.stats["migrations"] += 1
+                    self.stats["retire_migrations"] += 1
+                    next_pending.append(j)
+                    continue
                 eos = self.replicas[i].eos_id
                 if (j in hop and r.status == OK
                         and len(sess.tokens) + len(r.tokens)
@@ -827,8 +996,16 @@ class ServeRouter:
         fallback, not an error: the decode replica simply re-prefills
         the token-identical continuation (replay)."""
         cont = list(sess.req.tokens) + list(sess.tokens)
+        # version-aware dispatch (ISSUE 20): mid-rolling-upgrade the
+        # fleet transiently holds two weights_versions, and a
+        # cross-version import would decline anyway (the payload
+        # stamp) — skip those targets up front so the export D2H is
+        # never wasted; no same-version target just means replay
+        src_wv = getattr(self.replicas[i], "weights_version", 0)
         targets = [t for t in self.healthy_replicas()
-                   if t not in self._prefill_set]
+                   if t not in self._prefill_set
+                   and getattr(self.replicas[t], "weights_version", 0)
+                   == src_wv]
         ok, target = False, None
         if targets:
             target = max(targets, key=lambda t: (
